@@ -1,0 +1,30 @@
+"""ray_lightning_tpu — a TPU-native distributed training framework.
+
+Brand-new implementation of the capabilities of
+`ray_lightning <https://github.com/ray-project/ray_lightning>`_ (reference
+mounted at /root/reference), re-designed for TPU: strategies express
+parallelism as ``jax.sharding.Mesh`` axes, XLA compiles the collectives over
+ICI/DCN, and launchers host SPMD processes (one per TPU host) instead of
+one-per-GPU CUDA workers.
+
+Public API parity (``ray_lightning/__init__.py:1-5``): ``RayStrategy``,
+``HorovodRayStrategy``, ``RayShardedStrategy`` — plus the TPU-native names
+and the Trainer/module stack the reference borrows from PyTorch Lightning.
+"""
+
+from ray_lightning_tpu.strategies import (RayStrategy, DataParallelStrategy,
+                                          RayShardedStrategy, ZeroOneStrategy,
+                                          HorovodRayStrategy,
+                                          AllReduceStrategy, FSDPStrategy)
+from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
+                                    Callback, ModelCheckpoint,
+                                    EpochStatsCallback, seed_everything)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
+    "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
+    "FSDPStrategy", "Trainer", "TpuModule", "TpuDataModule", "Callback",
+    "ModelCheckpoint", "EpochStatsCallback", "seed_everything"
+]

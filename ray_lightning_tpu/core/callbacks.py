@@ -1,0 +1,190 @@
+"""Trainer callbacks.
+
+Callback hooks mirror the subset of PTL's callback API the reference's tests
+actually exercise (the "callback-as-probe" pattern, SURVEY.md §4): epoch
+start/end, batch end, validation end, sanity-check gates, plus checkpoint
+save/load state. ``EpochStatsCallback`` is the TPU analog of the reference's
+``CUDACallback`` (``examples/ray_ddp_sharded_example.py:16-45``) measuring
+epoch wall-time and device memory.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class Callback:
+    def setup(self, trainer, pl_module, stage: str) -> None: ...
+    def teardown(self, trainer, pl_module, stage: str) -> None: ...
+    def on_fit_start(self, trainer, pl_module) -> None: ...
+    def on_fit_end(self, trainer, pl_module) -> None: ...
+    def on_sanity_check_start(self, trainer, pl_module) -> None: ...
+    def on_sanity_check_end(self, trainer, pl_module) -> None: ...
+    def on_train_start(self, trainer, pl_module) -> None: ...
+    def on_train_end(self, trainer, pl_module) -> None: ...
+    def on_train_epoch_start(self, trainer, pl_module) -> None: ...
+    def on_train_epoch_end(self, trainer, pl_module) -> None: ...
+    def on_train_batch_start(self, trainer, pl_module, batch,
+                             batch_idx: int) -> None: ...
+    def on_train_batch_end(self, trainer, pl_module, outputs, batch,
+                           batch_idx: int) -> None: ...
+    def on_validation_start(self, trainer, pl_module) -> None: ...
+    def on_validation_end(self, trainer, pl_module) -> None: ...
+    def on_validation_epoch_start(self, trainer, pl_module) -> None: ...
+    def on_validation_epoch_end(self, trainer, pl_module) -> None: ...
+    def on_test_epoch_end(self, trainer, pl_module) -> None: ...
+    def on_save_checkpoint(self, trainer, pl_module,
+                           checkpoint: Dict[str, Any]) -> None: ...
+    def on_load_checkpoint(self, trainer, pl_module,
+                           checkpoint: Dict[str, Any]) -> None: ...
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+    def load_state_dict(self, state: Dict[str, Any]) -> None: ...
+
+
+class ModelCheckpoint(Callback):
+    """Epoch-end checkpointing with best-model tracking.
+
+    Parity target: PTL's ``ModelCheckpoint`` as used by the reference —
+    runs inside the rank-0 worker, and only ``best_model_path`` crosses back
+    to the driver (``ray_lightning/launchers/ray_launcher.py:320-322``).
+    """
+
+    def __init__(self,
+                 dirpath: Optional[str] = None,
+                 filename: str = "epoch={epoch}-step={step}",
+                 monitor: Optional[str] = None,
+                 mode: str = "min",
+                 save_top_k: int = 1,
+                 save_last: bool = False):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.dirpath = dirpath
+        self.filename = filename
+        self.monitor = monitor
+        self.mode = mode
+        self.save_top_k = save_top_k
+        self.save_last = save_last
+        self.best_model_path: str = ""
+        self.best_model_score: Optional[float] = None
+        self.last_model_path: str = ""
+        self._saved: list = []  # (score, path), worst-first
+
+    def setup(self, trainer, pl_module, stage: str) -> None:
+        if self.dirpath is None:
+            self.dirpath = os.path.join(trainer.default_root_dir,
+                                        "checkpoints")
+
+    def _is_better(self, score: float) -> bool:
+        if self.best_model_score is None:
+            return True
+        return (score < self.best_model_score if self.mode == "min" else
+                score > self.best_model_score)
+
+    def on_train_epoch_end(self, trainer, pl_module) -> None:
+        if trainer.global_rank != 0 or self.save_top_k == 0:
+            return
+        os.makedirs(self.dirpath, exist_ok=True)
+        name = self.filename.format(
+            epoch=trainer.current_epoch, step=trainer.global_step)
+        monitor_val = None
+        if self.monitor is not None:
+            raw = trainer.callback_metrics.get(self.monitor)
+            if raw is None:
+                # PTL semantics: monitored metric absent this epoch (e.g.
+                # validation didn't run) ⇒ skip, never rank an unscored
+                # checkpoint against real scores.
+                import warnings
+                warnings.warn(
+                    f"ModelCheckpoint: monitored metric {self.monitor!r} "
+                    "not found in callback_metrics; skipping checkpoint "
+                    "this epoch.")
+                return
+            monitor_val = float(np.asarray(raw))
+            name = f"{name}-{self.monitor}={monitor_val:.4f}"
+        path = os.path.join(self.dirpath, name + ".ckpt")
+        trainer.save_checkpoint(path)
+        score = monitor_val if monitor_val is not None else \
+            -float(trainer.global_step)  # no monitor: newest is best
+        if self._is_better(score):
+            self.best_model_score = score
+            self.best_model_path = path
+        self._saved.append((score, path))
+        self._prune()
+        if self.save_last:
+            self.last_model_path = os.path.join(self.dirpath, "last.ckpt")
+            trainer.save_checkpoint(self.last_model_path)
+
+    def _prune(self) -> None:
+        if self.save_top_k < 0:
+            return
+        reverse = self.mode == "max"
+        self._saved.sort(key=lambda t: t[0], reverse=reverse)
+        while len(self._saved) > self.save_top_k:
+            _score, path = self._saved.pop()
+            if path != self.best_model_path and os.path.exists(path):
+                os.remove(path)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "best_model_path": self.best_model_path,
+            "best_model_score": self.best_model_score,
+            "last_model_path": self.last_model_path,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.best_model_path = state.get("best_model_path", "")
+        self.best_model_score = state.get("best_model_score")
+        self.last_model_path = state.get("last_model_path", "")
+
+
+class EpochStatsCallback(Callback):
+    """Epoch wall-time + device HBM stats, averaged across the mesh.
+
+    TPU analog of the reference's ``CUDACallback``
+    (``examples/ray_ddp_sharded_example.py:16-45``), which records epoch
+    time and peak CUDA memory and all-reduces the averages. Under SPMD a
+    single process sees every local device, so the "all-reduce" is a host
+    mean over per-device memory stats.
+    """
+
+    def __init__(self, print_stats: bool = True):
+        self.print_stats = print_stats
+        self.epoch_times: list = []
+        self.peak_memory_mib: list = []
+        self._t0 = 0.0
+
+    def on_train_epoch_start(self, trainer, pl_module) -> None:
+        self._t0 = time.perf_counter()
+
+    def on_train_epoch_end(self, trainer, pl_module) -> None:
+        trainer.block_until_ready()
+        dt = time.perf_counter() - self._t0
+        self.epoch_times.append(dt)
+        peaks = []
+        for d in trainer.devices:
+            try:
+                stats = d.memory_stats()
+                if stats and "peak_bytes_in_use" in stats:
+                    peaks.append(stats["peak_bytes_in_use"] / 2**20)
+            except Exception:  # noqa: BLE001 - cpu backend has no stats
+                pass
+        peak = float(np.mean(peaks)) if peaks else 0.0
+        self.peak_memory_mib.append(peak)
+        if self.print_stats and trainer.global_rank == 0:
+            print(f"Epoch {trainer.current_epoch}: {dt:.2f}s, "
+                  f"avg peak HBM {peak:.0f} MiB")
+
+
+class LambdaCallback(Callback):
+    """Attach ad-hoc hook functions — the tests' callback-as-probe helper."""
+
+    def __init__(self, **hooks):
+        for name, fn in hooks.items():
+            if not hasattr(Callback, name):
+                raise ValueError(f"Unknown callback hook {name!r}")
+            setattr(self, name, fn)

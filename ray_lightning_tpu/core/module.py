@@ -1,0 +1,173 @@
+"""User-facing module contract — the LightningModule analog, functional-style.
+
+The reference rides PyTorch Lightning's ``LightningModule`` (models in
+``ray_lightning/tests/utils.py:28-148`` implement ``training_step``,
+``configure_optimizers``, dataloaders). The TPU-native contract keeps the
+same mental model but splits *stateful configuration* (done once, host-side)
+from *pure traced steps* (compiled by XLA):
+
+- ``configure_model()`` returns a flax ``nn.Module`` (the architecture).
+- ``configure_optimizers()`` returns an optax ``GradientTransformation``.
+- ``training_step(model, variables, batch, rng)`` is PURE: it is traced once
+  under ``jit`` and must contain no data-dependent Python control flow. It
+  returns a scalar loss (metrics via ``self.log`` or a ``(loss, logs)``
+  tuple).
+- ``self.log(name, value)`` works *inside* traced steps: logged tracers are
+  captured at trace time and threaded through the compiled function's
+  outputs, so per-step metrics incur zero extra host↔device syncs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import optax
+
+
+class _LogBuffer:
+    """Trace-time metric capture (see module docstring)."""
+
+    def __init__(self):
+        self._buf: Dict[str, Any] = {}
+        self._meta: Dict[str, Dict[str, Any]] = {}
+
+    def log(self, name, value, on_step=False, on_epoch=True, prog_bar=False):
+        self._buf[name] = value
+        self._meta[name] = dict(
+            on_step=on_step, on_epoch=on_epoch, prog_bar=prog_bar)
+
+    def drain(self) -> Tuple[Dict[str, Any], Dict[str, Dict[str, Any]]]:
+        buf, meta = self._buf, self._meta
+        self._buf, self._meta = {}, {}
+        return buf, meta
+
+
+class TpuModule:
+    """Base class for user models. See module docstring for the contract."""
+
+    def __init__(self):
+        self.trainer = None  # set by Trainer.fit
+        self._log_buffer = _LogBuffer()
+
+    # ------------------------------------------------------------------ #
+    # configuration (host-side, called once per fit inside the worker)
+    # ------------------------------------------------------------------ #
+    def configure_model(self):
+        """Return the flax ``nn.Module`` architecture."""
+        raise NotImplementedError
+
+    def configure_optimizers(self) -> optax.GradientTransformation:
+        """Return an optax transform (default: Adam 1e-3)."""
+        return optax.adam(1e-3)
+
+    def init_variables(self, model, rng, batch):
+        """Initialize model variables from an example batch.
+
+        Default heuristic: feed the first element of a tuple batch (the
+        inputs) or the batch itself. Override for models whose ``__call__``
+        takes extra arguments (masks, deterministic flags, ...). Runs under
+        ``jit`` with sharded outputs, so giant models initialize directly
+        into their sharded layout without a host-memory copy.
+        """
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return model.init(rng, x)
+
+    def setup(self, stage: str) -> None:
+        """Called in every worker before model construction."""
+
+    def teardown(self, stage: str) -> None:
+        """Called in every worker after the stage completes."""
+
+    def prepare_data(self) -> None:
+        """Host-side data download/preparation.
+
+        Parity with PTL ``prepare_data`` as invoked by the reference worker
+        (``ray_lightning/launchers/ray_launcher.py:291``): runs once per
+        worker process before the fit loop.
+        """
+
+    # ------------------------------------------------------------------ #
+    # pure steps (traced under jit; NO python side effects besides log())
+    # ------------------------------------------------------------------ #
+    def training_step(self, model, variables, batch, rng):
+        """Return scalar loss, or ``(loss, logs)``, or
+        ``(loss, logs, mutated_model_state)`` for models with mutable
+        collections (e.g. batchnorm ``batch_stats``)."""
+        raise NotImplementedError
+
+    def validation_step(self, model, variables, batch, rng) -> Dict[str, Any]:
+        """Return a dict of metric scalars (or use ``self.log``)."""
+        return {}
+
+    def test_step(self, model, variables, batch, rng) -> Dict[str, Any]:
+        return self.validation_step(model, variables, batch, rng)
+
+    def predict_step(self, model, variables, batch, rng):
+        return model.apply(variables, batch)
+
+    # ------------------------------------------------------------------ #
+    # logging
+    # ------------------------------------------------------------------ #
+    def log(self, name: str, value: Any, on_step: bool = False,
+            on_epoch: bool = True, prog_bar: bool = False,
+            sync_dist: bool = True) -> None:
+        """Log a metric from inside (or outside) a traced step.
+
+        ``sync_dist`` is accepted for API parity; under SPMD every metric is
+        already computed on the global batch, so cross-worker reduction is
+        implicit — the collective the reference needs here (PTL's
+        ``sync_dist`` all-reduce) does not exist as a separate step.
+        """
+        del sync_dist
+        self._log_buffer.log(name, value, on_step, on_epoch, prog_bar)
+
+    # ------------------------------------------------------------------ #
+    # data
+    # ------------------------------------------------------------------ #
+    def train_dataloader(self) -> Iterable:
+        raise NotImplementedError
+
+    def val_dataloader(self) -> Optional[Iterable]:
+        return None
+
+    def test_dataloader(self) -> Optional[Iterable]:
+        return None
+
+    def predict_dataloader(self) -> Optional[Iterable]:
+        return None
+
+    # ------------------------------------------------------------------ #
+    # hooks (subset of PTL's, the ones the reference's tests exercise)
+    # ------------------------------------------------------------------ #
+    def on_fit_start(self) -> None: ...
+    def on_fit_end(self) -> None: ...
+    def on_train_start(self) -> None: ...
+    def on_train_end(self) -> None: ...
+    def on_train_epoch_start(self) -> None: ...
+    def on_train_epoch_end(self) -> None: ...
+    def on_validation_epoch_start(self) -> None: ...
+    def on_validation_epoch_end(self) -> None: ...
+
+    # checkpointable custom state (parity: BoringModel's
+    # on_save_checkpoint/on_load_checkpoint, tests/utils.py:28-96)
+    def on_save_checkpoint(self, checkpoint: Dict[str, Any]) -> None: ...
+    def on_load_checkpoint(self, checkpoint: Dict[str, Any]) -> None: ...
+
+
+class TpuDataModule:
+    """Datamodule analog (parity: ``XORDataModule``, tests/utils.py:151-210)."""
+
+    def prepare_data(self) -> None: ...
+
+    def setup(self, stage: str) -> None: ...
+
+    def train_dataloader(self) -> Iterable:
+        raise NotImplementedError
+
+    def val_dataloader(self) -> Optional[Iterable]:
+        return None
+
+    def test_dataloader(self) -> Optional[Iterable]:
+        return None
+
+    def predict_dataloader(self) -> Optional[Iterable]:
+        return None

@@ -1,0 +1,38 @@
+"""Deterministic-seed plumbing.
+
+Parity with the reference's seed chain: ``PL_GLOBAL_SEED`` is forwarded from
+driver to every worker (``ray_lightning/launchers/ray_launcher.py:170-173``)
+and re-applied per worker via ``reset_seed()`` (``ray_ddp.py:177``). The env
+var here is ``TPU_PL_GLOBAL_SEED``; JAX randomness additionally flows through
+explicit PRNG keys derived from the seed, which is the actually-load-bearing
+path for reproducibility under XLA.
+"""
+from __future__ import annotations
+
+import os
+import random
+from typing import Optional
+
+import numpy as np
+
+GLOBAL_SEED_ENV = "TPU_PL_GLOBAL_SEED"
+
+
+def seed_everything(seed: Optional[int] = None) -> int:
+    """Seed python/numpy RNGs and record the seed for worker forwarding."""
+    if seed is None:
+        env = os.environ.get(GLOBAL_SEED_ENV)
+        seed = int(env) if env is not None else random.randint(0, 2**31 - 1)
+    seed = int(seed)
+    os.environ[GLOBAL_SEED_ENV] = str(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed
+
+
+def reset_seed() -> Optional[int]:
+    """Re-apply the driver's seed inside a worker (parity: ``reset_seed()``)."""
+    env = os.environ.get(GLOBAL_SEED_ENV)
+    if env is None:
+        return None
+    return seed_everything(int(env))

@@ -1,0 +1,51 @@
+"""The replicated/sharded training state container.
+
+Everything the hot loop touches lives here as one pytree so the whole step is
+a single donated-argument jitted function: ``state' = step(state, batch)``.
+This replaces the reference's mutable torch module + optimizer objects (the
+DDP-wrapped model living inside each Ray actor) with the functional
+equivalent XLA can fuse and shard.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """Pure pytree training state.
+
+    Attributes:
+        step: global optimizer step (int32 scalar on device).
+        params: model parameters pytree.
+        opt_state: optax optimizer state pytree (this is what ZeRO-1 shards).
+        model_state: mutable model collections (e.g. flax ``batch_stats``).
+        rng: PRNG key folded per-step for dropout etc.
+    """
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    model_state: Dict[str, Any]
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, opt_state: Any,
+               model_state: Optional[Dict[str, Any]] = None,
+               rng: Optional[jax.Array] = None) -> "TrainState":
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            model_state=model_state or {},
+            rng=rng)
+
+    @property
+    def variables(self) -> Dict[str, Any]:
+        """Variables dict as flax ``Module.apply`` expects."""
+        return {"params": self.params, **self.model_state}

@@ -1,0 +1,667 @@
+"""The Trainer: PTL-style fit/validate/test/predict driving compiled SPMD loops.
+
+The reference never implements a training loop — it ships PTL's Trainer into
+Ray actors (``ray_lightning/launchers/ray_launcher.py:222-311``) and lets it
+re-enter. Building TPU-native means owning that loop: here the hot path is a
+single donated, jitted ``step(state, batch)`` whose gradient collectives XLA
+derives from strategy sharding annotations, and the Trainer around it
+reproduces the orchestration contract the reference adds on top of PTL:
+
+- strategies install launchers; ``fit`` runs through ``launcher.launch``
+  (parity: ``ray_ddp.py:128-136`` → ``ray_launcher.py:48-69``),
+- rank-0 results come back as a :class:`WorkerOutput` — state as bytes,
+  metrics as numpy (parity: ``ray_launcher.py:313-350``),
+- the driver recovers weights/metrics into the user-visible objects
+  (parity: ``ray_launcher.py:352-380``),
+- Tune-style callbacks reach the driver through the session queue, drained
+  between batches (parity: ``util.py:49-70``).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization
+
+from ray_lightning_tpu import util as _util
+from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
+from ray_lightning_tpu.core.module import TpuDataModule, TpuModule
+from ray_lightning_tpu.core.seed import seed_everything
+from ray_lightning_tpu.core.train_state import TrainState
+from ray_lightning_tpu.launchers.utils import WorkerOutput
+
+
+def _normalize_step_output(out: Any, prev_model_state: Any):
+    """training_step may return loss | (loss, logs) | (loss, logs, state)."""
+    if isinstance(out, tuple):
+        if len(out) == 2:
+            return out[0], dict(out[1]), prev_model_state
+        if len(out) == 3:
+            return out[0], dict(out[1]), out[2]
+        raise ValueError(
+            f"training_step returned a {len(out)}-tuple; expected "
+            "loss, (loss, logs) or (loss, logs, model_state)")
+    return out, {}, prev_model_state
+
+
+class Trainer:
+    def __init__(self,
+                 strategy=None,
+                 max_epochs: int = 1,
+                 max_steps: int = -1,
+                 callbacks: Optional[List[Callback]] = None,
+                 limit_train_batches: Optional[float] = None,
+                 limit_val_batches: Optional[float] = None,
+                 limit_test_batches: Optional[float] = None,
+                 limit_predict_batches: Optional[float] = None,
+                 num_sanity_val_steps: int = 0,
+                 check_val_every_n_epoch: int = 1,
+                 enable_checkpointing: bool = False,
+                 default_root_dir: Optional[str] = None,
+                 enable_progress_bar: bool = False,
+                 log_every_n_steps: int = 50,
+                 precision: str = "32",
+                 gradient_clip_val: Optional[float] = None,
+                 accumulate_grad_batches: int = 1,
+                 seed: Optional[int] = None):
+        from ray_lightning_tpu.strategies.ddp import RayStrategy
+        self.strategy = strategy if strategy is not None else RayStrategy(
+            num_workers=1)
+        self.max_epochs = max_epochs
+        self.max_steps = max_steps
+        self.callbacks: List[Callback] = list(callbacks or [])
+        self.limit_train_batches = limit_train_batches
+        self.limit_val_batches = limit_val_batches
+        self.limit_test_batches = limit_test_batches
+        self.limit_predict_batches = limit_predict_batches
+        self.num_sanity_val_steps = num_sanity_val_steps
+        self.check_val_every_n_epoch = check_val_every_n_epoch
+        self.enable_checkpointing = enable_checkpointing
+        self.default_root_dir = default_root_dir or os.path.join(
+            os.getcwd(), "tpu_lightning_logs")
+        self.enable_progress_bar = enable_progress_bar
+        self.log_every_n_steps = log_every_n_steps
+        self.precision = str(precision)
+        self.gradient_clip_val = gradient_clip_val
+        self.accumulate_grad_batches = int(accumulate_grad_batches)
+        self.seed = seed_everything(seed) if seed is not None else None
+
+        if self.enable_checkpointing and not any(
+                isinstance(cb, ModelCheckpoint) for cb in self.callbacks):
+            self.callbacks.append(ModelCheckpoint())
+
+        # progress / results (user-visible, PTL names)
+        self.current_epoch = 0
+        self.global_step = 0
+        self.callback_metrics: Dict[str, Any] = {}
+        self.logged_metrics: Dict[str, Any] = {}
+        self.sanity_checking = False
+        self.state = "idle"
+        self.train_state: Optional[TrainState] = None
+
+        # worker-side handles (populated inside the launched fit)
+        self._module: Optional[TpuModule] = None
+        self._model = None
+        self._launcher = None
+        self._last_logs: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    # properties
+    # ------------------------------------------------------------------ #
+    @property
+    def mesh(self):
+        return self.strategy.mesh
+
+    @property
+    def devices(self) -> List[jax.Device]:
+        return list(self.strategy.mesh.devices.flat)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    @property
+    def global_rank(self) -> int:
+        return self.strategy.global_rank
+
+    @property
+    def world_size(self) -> int:
+        return self.strategy.world_size
+
+    @property
+    def checkpoint_callback(self) -> Optional[ModelCheckpoint]:
+        for cb in self.callbacks:
+            if isinstance(cb, ModelCheckpoint):
+                return cb
+        return None
+
+    def block_until_ready(self) -> None:
+        if self.train_state is not None:
+            jax.block_until_ready(self.train_state.params)
+
+    # ------------------------------------------------------------------ #
+    # entry points (driver side)
+    # ------------------------------------------------------------------ #
+    def fit(self, module: TpuModule,
+            datamodule: Optional[TpuDataModule] = None,
+            ckpt_path: Optional[str] = None) -> None:
+        self.state = "fitting"
+        self._launcher = self.strategy.configure_launcher()
+        output = self._launcher.launch(
+            self._fit_worker, module, datamodule, ckpt_path, trainer=self)
+        self._recover_results(output, module)
+        self.state = "finished"
+
+    def validate(self, module: TpuModule,
+                 datamodule: Optional[TpuDataModule] = None,
+                 ckpt_path: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self._run_evaluate(module, datamodule, ckpt_path, "validate")
+
+    def test(self, module: TpuModule,
+             datamodule: Optional[TpuDataModule] = None,
+             ckpt_path: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self._run_evaluate(module, datamodule, ckpt_path, "test")
+
+    def predict(self, module: TpuModule,
+                datamodule: Optional[TpuDataModule] = None,
+                ckpt_path: Optional[str] = None) -> List[Any]:
+        self.state = "predicting"
+        self._launcher = self.strategy.configure_launcher()
+        output = self._launcher.launch(
+            self._predict_worker, module, datamodule, ckpt_path, trainer=self)
+        self.state = "finished"
+        return output.results
+
+    def _run_evaluate(self, module, datamodule, ckpt_path,
+                      stage: str) -> List[Dict[str, Any]]:
+        self.state = f"{stage[:-1] if stage.endswith('e') else stage}ing"
+        self._launcher = self.strategy.configure_launcher()
+        output = self._launcher.launch(
+            self._evaluate_worker, module, datamodule, ckpt_path, stage,
+            trainer=self)
+        self.callback_metrics.update(
+            _util.numpy_metrics_to_device(output.callback_metrics))
+        self.state = "finished"
+        return output.results
+
+    # ------------------------------------------------------------------ #
+    # worker-side setup
+    # ------------------------------------------------------------------ #
+    def _attach(self, module: TpuModule,
+                datamodule: Optional[TpuDataModule]) -> None:
+        module.trainer = self
+        self._module = module
+        self._datamodule = datamodule
+        self.strategy.set_world_ranks(jax.process_index())
+
+    def _dataloader(self, name: str):
+        if self._datamodule is not None:
+            loader = getattr(self._datamodule, name)()
+            if loader is not None:
+                return loader
+        return getattr(self._module, name)()
+
+    @staticmethod
+    def _peek_first_batch(loader):
+        """First batch + a loader safe to iterate from the start.
+
+        Re-iterable loaders pass through untouched; a bare iterator or
+        generator gets its consumed head chained back on so batch 0 is
+        still trained (multi-epoch runs need a re-iterable loader)."""
+        import itertools
+        it = iter(loader)
+        first = next(it)
+        if it is loader:  # non-re-iterable: iter() returned self
+            loader = itertools.chain([first], it)
+        return first, loader
+
+    def _optimizer(self) -> optax.GradientTransformation:
+        tx = self._module.configure_optimizers()
+        chain = []
+        if self.gradient_clip_val:
+            chain.append(optax.clip_by_global_norm(self.gradient_clip_val))
+        chain.append(tx)
+        tx = optax.chain(*chain) if len(chain) > 1 else tx
+        if self.accumulate_grad_batches > 1:
+            tx = optax.MultiSteps(tx, self.accumulate_grad_batches)
+        return tx
+
+    def _cast_batch(self, batch: Any) -> Any:
+        if not self.precision.startswith("bf16"):
+            return batch
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x, batch)
+
+    def _setup_state(self, sample_batch: Any,
+                     restored: Optional[Dict[str, Any]] = None):
+        """Init (or restore) the sharded TrainState + compiled steps.
+
+        Two-phase init: abstract shapes via ``eval_shape``, then strategy
+        sharding rules, then a jitted init with ``out_shardings`` — so even
+        FSDP-sharded giants materialize directly in their sharded layout.
+        """
+        strategy = self.strategy
+        mesh = strategy.mesh
+        module = self._module
+        model = module.configure_model()
+        self._model = model
+        tx = self._optimizer()
+        seed = self.seed if self.seed is not None else 0
+        root_rng = jax.random.PRNGKey(seed)
+        init_rng, state_rng = jax.random.split(root_rng)
+
+        sample_batch = self._cast_batch(sample_batch)
+        batch_sharding = strategy.batch_sharding()
+        device_batch = jax.device_put(sample_batch, batch_sharding)
+
+        def init_fn(rng, batch):
+            variables = module.init_variables(model, rng, batch)
+            params = variables.pop("params")
+            model_state = dict(variables)
+            opt_state = tx.init(params)
+            return TrainState.create(params, opt_state, model_state,
+                                     state_rng)
+
+        abstract = jax.eval_shape(init_fn, init_rng, device_batch)
+        state_shardings = TrainState(
+            step=strategy.scalar_sharding(),
+            params=strategy.params_sharding(abstract.params),
+            opt_state=strategy.opt_state_sharding(abstract.opt_state),
+            model_state=strategy.model_state_sharding(abstract.model_state),
+            rng=strategy.scalar_sharding())
+        state = jax.jit(
+            init_fn, out_shardings=state_shardings)(init_rng, device_batch)
+
+        if restored is not None:
+            host_state = serialization.from_state_dict(
+                jax.device_get(state), restored)
+            state = jax.device_put(host_state, state_shardings)
+
+        def loss_fn(params, model_state, batch, rng):
+            variables = {"params": params, **model_state}
+            out = module.training_step(model, variables, batch, rng)
+            logged, _meta = module._log_buffer.drain()
+            loss, logs, new_ms = _normalize_step_output(out, model_state)
+            return loss, ({**logs, **logged}, new_ms)
+
+        def eval_fn_builder(step_name):
+            def eval_fn(params, model_state, batch, rng):
+                variables = {"params": params, **model_state}
+                logs = getattr(module, step_name)(model, variables, batch,
+                                                  rng)
+                logged, _meta = module._log_buffer.drain()
+                return {**(logs or {}), **logged}
+            return eval_fn
+
+        train_step = strategy.make_train_step(
+            loss_fn, tx, state_shardings, batch_sharding)
+        val_step = strategy.make_eval_step(
+            eval_fn_builder("validation_step"), state_shardings,
+            batch_sharding)
+        test_step = strategy.make_eval_step(
+            eval_fn_builder("test_step"), state_shardings, batch_sharding)
+
+        self._state_shardings = state_shardings
+        self._batch_sharding = batch_sharding
+        self._train_step = train_step
+        self._val_step = val_step
+        self._test_step = test_step
+        self.train_state = state
+        return state
+
+    # ------------------------------------------------------------------ #
+    # fit loop (worker side)
+    # ------------------------------------------------------------------ #
+    def _fit_worker(self, module: TpuModule,
+                    datamodule: Optional[TpuDataModule],
+                    ckpt_path: Optional[str]) -> WorkerOutput:
+        self._attach(module, datamodule)
+        module.prepare_data()
+        if datamodule is not None:
+            datamodule.prepare_data()
+            datamodule.setup("fit")
+        module.setup("fit")
+        for cb in self.callbacks:
+            cb.setup(self, module, "fit")
+
+        train_loader = self._dataloader("train_dataloader")
+        val_loader = self._dataloader("val_dataloader")
+
+        sample_batch, train_loader = self._peek_first_batch(train_loader)
+        restored_ckpt = None
+        if ckpt_path is not None:
+            restored_ckpt = self._read_checkpoint(ckpt_path)
+        state = self._setup_state(
+            sample_batch,
+            restored_ckpt["state"] if restored_ckpt else None)
+        start_epoch = 0
+        if restored_ckpt is not None:
+            start_epoch = int(restored_ckpt.get("epoch", -1)) + 1
+            self.global_step = int(restored_ckpt.get("global_step", 0))
+            for cb in self.callbacks:
+                cb_state = restored_ckpt.get("callbacks", {}).get(
+                    type(cb).__name__)
+                if cb_state:
+                    cb.load_state_dict(cb_state)
+            module.on_load_checkpoint(restored_ckpt.get("module", {}))
+
+        module.on_fit_start()
+        for cb in self.callbacks:
+            cb.on_fit_start(self, module)
+
+        # sanity validation (PTL parity; Tune callbacks skip this phase)
+        if val_loader is not None and self.num_sanity_val_steps > 0:
+            self.sanity_checking = True
+            for cb in self.callbacks:
+                cb.on_sanity_check_start(self, module)
+            self._eval_loop(val_loader, self._val_step,
+                            self.num_sanity_val_steps)
+            for cb in self.callbacks:
+                cb.on_sanity_check_end(self, module)
+            self.sanity_checking = False
+
+        module.on_train_start()
+        for cb in self.callbacks:
+            cb.on_train_start(self, module)
+
+        stop = False
+        for epoch in range(start_epoch, self.max_epochs):
+            self.current_epoch = epoch
+            if hasattr(train_loader, "set_epoch"):
+                train_loader.set_epoch(epoch)
+            module.on_train_epoch_start()
+            for cb in self.callbacks:
+                cb.on_train_epoch_start(self, module)
+
+            epoch_logs: List[Dict[str, Any]] = []
+            n_batches = self._resolve_limit(train_loader,
+                                            self.limit_train_batches)
+            t0 = time.perf_counter()
+            for batch_idx, batch in enumerate(train_loader):
+                if batch_idx >= n_batches:
+                    break
+                for cb in self.callbacks:
+                    cb.on_train_batch_start(self, module, batch, batch_idx)
+                batch = jax.device_put(
+                    self._cast_batch(batch), self._batch_sharding)
+                state, logs = self._train_step(state, batch)
+                self.train_state = state
+                self.global_step += 1
+                epoch_logs.append(logs)
+                self._last_logs = logs
+                for cb in self.callbacks:
+                    cb.on_train_batch_end(self, module, logs, batch,
+                                          batch_idx)
+                if hasattr(self._launcher, "drain_queue"):
+                    self._launcher.drain_queue()
+                if 0 <= self.max_steps <= self.global_step:
+                    stop = True
+                    break
+
+            # epoch aggregation: one host sync per epoch, not per step
+            agg = self._aggregate_epoch_logs(epoch_logs, prefix="train_")
+            self.callback_metrics.update(agg)
+            if epoch_logs:
+                self.logged_metrics = _util.tensor_metrics_to_numpy(
+                    jax.device_get(epoch_logs[-1]))
+            if self.enable_progress_bar and self.strategy.global_rank == 0:
+                dt = time.perf_counter() - t0
+                msg = ", ".join(f"{k}={v:.4f}" for k, v in agg.items()
+                                if np.isscalar(v))
+                print(f"epoch {epoch}: {msg} ({dt:.1f}s)")
+
+            if val_loader is not None and not stop and \
+                    (epoch + 1) % self.check_val_every_n_epoch == 0:
+                self._run_validation(val_loader, module)
+
+            module.on_train_epoch_end()
+            for cb in self.callbacks:
+                cb.on_train_epoch_end(self, module)
+            if stop:
+                break
+
+        module.on_train_end()
+        for cb in self.callbacks:
+            cb.on_train_end(self, module)
+        module.on_fit_end()
+        for cb in self.callbacks:
+            cb.on_fit_end(self, module)
+        module.teardown("fit")
+        for cb in self.callbacks:
+            cb.teardown(self, module, "fit")
+
+        return self._collect_rank_zero_results()
+
+    def _run_validation(self, val_loader, module) -> None:
+        module.on_validation_epoch_start()
+        for cb in self.callbacks:
+            cb.on_validation_start(self, module)
+            cb.on_validation_epoch_start(self, module)
+        n = self._resolve_limit(val_loader, self.limit_val_batches)
+        agg = self._eval_loop(val_loader, self._val_step, n)
+        self.callback_metrics.update(agg)
+        module.on_validation_epoch_end()
+        for cb in self.callbacks:
+            cb.on_validation_epoch_end(self, module)
+            cb.on_validation_end(self, module)
+        if hasattr(self._launcher, "drain_queue"):
+            self._launcher.drain_queue()
+
+    def _eval_loop(self, loader, step_fn,
+                   n_batches: int) -> Dict[str, Any]:
+        logs_list: List[Dict[str, Any]] = []
+        rng = jax.random.PRNGKey(0)
+        for batch_idx, batch in enumerate(loader):
+            if batch_idx >= n_batches:
+                break
+            batch = jax.device_put(
+                self._cast_batch(batch), self._batch_sharding)
+            logs = step_fn(self.train_state, batch,
+                           jax.random.fold_in(rng, batch_idx))
+            logs_list.append(logs)
+        return self._aggregate_epoch_logs(logs_list)
+
+    def _aggregate_epoch_logs(self, logs_list: List[Dict[str, Any]],
+                              prefix: str = "") -> Dict[str, Any]:
+        if not logs_list:
+            return {}
+        host = jax.device_get(logs_list)
+        keys = host[0].keys()
+        out: Dict[str, Any] = {}
+        for k in keys:
+            vals = [np.asarray(h[k]) for h in host if k in h]
+            name = k if (k != "loss" or not prefix) else prefix + k
+            out[name] = float(np.mean([v.mean() for v in vals]))
+        return out
+
+    def _resolve_limit(self, loader, limit) -> int:
+        try:
+            total = len(loader)
+        except TypeError:
+            total = float("inf")
+        if limit is None:
+            return total if total != float("inf") else 2**31
+        if isinstance(limit, float) and 0 <= limit <= 1:
+            if total == float("inf"):
+                raise ValueError(
+                    "A fractional batch limit requires a dataloader with "
+                    "__len__; pass an integer limit instead.")
+            return int(total * limit)
+        return int(limit)
+
+    # ------------------------------------------------------------------ #
+    # evaluate / predict workers
+    # ------------------------------------------------------------------ #
+    def _prepare_eval(self, module, datamodule, ckpt_path, stage: str,
+                      loader_name: str):
+        self._attach(module, datamodule)
+        module.prepare_data()
+        if datamodule is not None:
+            datamodule.prepare_data()
+            datamodule.setup(stage)
+        module.setup(stage)
+        loader = self._dataloader(loader_name)
+        if loader is None:
+            raise ValueError(f"No {loader_name} defined for {stage}")
+        restored = self._read_checkpoint(ckpt_path) if ckpt_path else None
+        restored_state = restored["state"] if restored else None
+        if restored_state is None and self.train_state is None:
+            # weights recovered from a remote fit without a local template
+            restored_state = getattr(self, "train_state_dict", None)
+        if self.train_state is None or restored_state is not None:
+            sample, loader = self._peek_first_batch(loader)
+            self._setup_state(sample, restored_state)
+        elif not hasattr(self, "_val_step"):
+            sample, loader = self._peek_first_batch(loader)
+            self._setup_state(sample)
+        return loader
+
+    def _evaluate_worker(self, module, datamodule, ckpt_path,
+                         stage: str) -> WorkerOutput:
+        loader_name = ("val_dataloader" if stage == "validate" else
+                       "test_dataloader")
+        loader = self._prepare_eval(module, datamodule, ckpt_path, stage,
+                                    loader_name)
+        limit = (self.limit_val_batches if stage == "validate" else
+                 self.limit_test_batches)
+        step = self._val_step if stage == "validate" else self._test_step
+        n = self._resolve_limit(loader, limit)
+        agg = self._eval_loop(loader, step, n)
+        self.callback_metrics.update(agg)
+        for cb in self.callbacks:
+            if stage == "test":
+                cb.on_test_epoch_end(self, module)
+        return WorkerOutput(
+            best_model_path=None,
+            state_stream=None,
+            trainer_state=dict(epoch=self.current_epoch,
+                               global_step=self.global_step),
+            callback_metrics=_util.tensor_metrics_to_numpy(
+                self.callback_metrics),
+            logged_metrics={},
+            results=[agg])
+
+    def _predict_worker(self, module, datamodule,
+                        ckpt_path) -> WorkerOutput:
+        loader = self._prepare_eval(module, datamodule, ckpt_path, "predict",
+                                    "predict_dataloader")
+        model = self._model
+        state_shardings = self._state_shardings
+
+        @jax.jit
+        def predict_step(state, batch):
+            return module.predict_step(model, state.variables, batch,
+                                       state.rng)
+
+        n = self._resolve_limit(loader, self.limit_predict_batches)
+        outs = []
+        for batch_idx, batch in enumerate(loader):
+            if batch_idx >= n:
+                break
+            batch = jax.device_put(
+                self._cast_batch(batch), self._batch_sharding)
+            outs.append(jax.device_get(
+                predict_step(self.train_state, batch)))
+        return WorkerOutput(
+            best_model_path=None, state_stream=None,
+            trainer_state=dict(epoch=self.current_epoch,
+                               global_step=self.global_step),
+            callback_metrics={}, logged_metrics={}, results=outs)
+
+    # ------------------------------------------------------------------ #
+    # results / checkpointing (worker↔driver contract)
+    # ------------------------------------------------------------------ #
+    def _collect_rank_zero_results(self) -> WorkerOutput:
+        """Parity: ``ray_launcher.py:313-350`` — best ckpt path, state as an
+        in-memory byte stream, progress counters, numpy metrics."""
+        ckpt_cb = self.checkpoint_callback
+        best_path = ckpt_cb.best_model_path if ckpt_cb else None
+        stream = None
+        if self.strategy.is_remote:
+            stream = _util.to_state_stream(
+                serialization.to_state_dict(self.train_state))
+        return WorkerOutput(
+            best_model_path=best_path,
+            state_stream=stream,
+            trainer_state=dict(epoch=self.current_epoch,
+                               global_step=self.global_step),
+            callback_metrics=_util.tensor_metrics_to_numpy(
+                self.callback_metrics),
+            logged_metrics=_util.tensor_metrics_to_numpy(
+                self.logged_metrics),
+            callback_states={
+                type(cb).__name__: cb.state_dict()
+                for cb in self.callbacks
+            })
+
+    def _recover_results(self, output: WorkerOutput,
+                         module: TpuModule) -> None:
+        """Parity: ``ray_launcher.py:352-380`` — restore weights, trainer
+        progress, metrics into driver-side objects."""
+        if output is None:
+            return
+        self.current_epoch = output.trainer_state.get(
+            "epoch", self.current_epoch)
+        self.global_step = output.trainer_state.get(
+            "global_step", self.global_step)
+        self.callback_metrics.update(
+            _util.numpy_metrics_to_device(output.callback_metrics))
+        self.logged_metrics.update(
+            _util.numpy_metrics_to_device(output.logged_metrics))
+        if output.state_stream is not None:
+            restored = _util.load_state_stream(output.state_stream)
+            if self.train_state is not None and \
+                    hasattr(self, "_state_shardings"):
+                host = serialization.from_state_dict(
+                    jax.device_get(self.train_state), restored)
+                self.train_state = jax.device_put(host,
+                                                  self._state_shardings)
+            else:
+                # Remote launch with no driver-side template: keep the raw
+                # state dict; `restore_train_state` re-materializes it once
+                # a mesh/template exists (e.g. a later validate/predict).
+                self.train_state_dict = restored
+        if output.callback_states:
+            for cb in self.callbacks:
+                st = output.callback_states.get(type(cb).__name__)
+                if st:
+                    cb.load_state_dict(st)
+
+    def save_checkpoint(self, filepath: str) -> None:
+        """Dump a full resumable checkpoint (rank-0 only in multi-host)."""
+        ckpt = self.dump_checkpoint()
+        os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
+        with open(filepath, "wb") as f:
+            f.write(_util.to_state_stream(ckpt))
+
+    def dump_checkpoint(self) -> Dict[str, Any]:
+        module_state: Dict[str, Any] = {}
+        if self._module is not None:
+            self._module.on_save_checkpoint(module_state)
+        ckpt = {
+            "epoch": self.current_epoch,
+            "global_step": self.global_step,
+            "state": serialization.to_state_dict(
+                jax.device_get(self.train_state)),
+            "callbacks": {
+                type(cb).__name__: cb.state_dict()
+                for cb in self.callbacks
+            },
+            "module": module_state,
+        }
+        for cb in self.callbacks:
+            cb.on_save_checkpoint(self, self._module, ckpt)
+        return ckpt
+
+    def _read_checkpoint(self, path: str) -> Dict[str, Any]:
+        with open(path, "rb") as f:
+            ckpt = _util.load_state_stream(f.read())
+        for cb in self.callbacks:
+            cb.on_load_checkpoint(self, self._module, ckpt)
+        return ckpt

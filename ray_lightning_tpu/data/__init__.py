@@ -1,0 +1,3 @@
+from ray_lightning_tpu.data.loader import DataLoader, ArrayDataset
+
+__all__ = ["DataLoader", "ArrayDataset"]

@@ -1,0 +1,85 @@
+"""Host-side data pipeline.
+
+The reference delegates data loading to torch ``DataLoader`` +
+``DistributedSampler`` configured per worker (``ray_ddp.py:325-334``). The
+TPU-native pipeline is *global-batch* oriented: one logical batch per step,
+laid out with its leading dim sharded across the mesh's data axes. Static
+shapes are non-negotiable under XLA, so the loader drops ragged tails by
+default (``drop_last=True``) rather than triggering a recompile on the final
+batch.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class ArrayDataset:
+    """A pytree of same-leading-dim numpy arrays acting as a dataset."""
+
+    def __init__(self, *arrays: Any):
+        if len(arrays) == 1:
+            self.tree = arrays[0]
+        else:
+            self.tree = tuple(arrays)
+        leaves = jax.tree_util.tree_leaves(self.tree)
+        if not leaves:
+            raise ValueError("ArrayDataset needs at least one array")
+        self._len = leaves[0].shape[0]
+        for leaf in leaves:
+            if leaf.shape[0] != self._len:
+                raise ValueError("All arrays must share the leading dim")
+
+    def __len__(self) -> int:
+        return self._len
+
+    def take(self, idx: np.ndarray) -> Any:
+        return jax.tree_util.tree_map(lambda a: a[idx], self.tree)
+
+
+class DataLoader:
+    """Minimal global-batch loader over an :class:`ArrayDataset`.
+
+    ``shuffle`` reshuffles each epoch with a per-epoch derived seed
+    (deterministic given ``seed``, parity with the reference's seed
+    plumbing). ``drop_last=True`` keeps shapes static for XLA.
+    """
+
+    def __init__(self,
+                 dataset: Any,
+                 batch_size: int = 1,
+                 shuffle: bool = False,
+                 seed: int = 0,
+                 drop_last: bool = True):
+        if not isinstance(dataset, ArrayDataset):
+            dataset = ArrayDataset(dataset)
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self.dataset) / self.batch_size
+        return math.floor(n) if self.drop_last else math.ceil(n)
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last \
+            else n
+        for start in range(0, stop, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            yield self.dataset.take(idx)
+        self._epoch += 1

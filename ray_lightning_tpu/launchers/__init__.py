@@ -1,0 +1,4 @@
+from ray_lightning_tpu.launchers.utils import WorkerOutput, find_free_port
+from ray_lightning_tpu.launchers.local import LocalLauncher
+
+__all__ = ["WorkerOutput", "find_free_port", "LocalLauncher"]

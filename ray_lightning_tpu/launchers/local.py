@@ -1,0 +1,71 @@
+"""In-process SPMD launcher — the default execution path.
+
+The deepest TPU-first departure from the reference: where the reference must
+spawn one OS process per GPU and bootstrap NCCL between them
+(``ray_lightning/launchers/ray_launcher.py:48-69``), a single XLA process
+drives *all* local TPU chips as one SPMD program — so "launching" N workers
+locally means building an N-device mesh, not forking N processes. The
+launcher contract (setup → run function → collect rank-0 output → recover in
+driver, ``launch()`` parity) is preserved so multi-host launchers (one
+process per TPU host) slot in behind the same interface.
+"""
+from __future__ import annotations
+
+import queue as _queue
+from typing import Any, Callable
+
+from ray_lightning_tpu import session as _session
+from ray_lightning_tpu.core.seed import reset_seed
+from ray_lightning_tpu.launchers.utils import WorkerOutput
+
+
+class LocalLauncher:
+    """Runs the launched function in-process over the local device mesh."""
+
+    def __init__(self, strategy):
+        self._strategy = strategy
+        self.queue: Any = None
+
+    @property
+    def is_interactive_compatible(self) -> bool:
+        return True
+
+    def launch(self, function: Callable, *args, trainer=None, **kwargs) -> Any:
+        """Parity with ``RayLauncher.launch`` (``ray_launcher.py:48-69``):
+        setup session → run → drain queue → teardown. No process boundary,
+        so the "ship the trainer" serialization step vanishes; the launched
+        function runs directly and its ``WorkerOutput`` is recovered
+        in-place.
+        """
+        reset_seed()
+        self.queue = _queue.Queue()
+        if self._strategy.init_hook is not None:
+            self._strategy.init_hook()
+        _session.shutdown_session()
+        _session.init_session(rank=0, queue=self.queue)
+        try:
+            result = function(*args, **kwargs)
+        finally:
+            self.drain_queue()
+            _session.shutdown_session()
+        return result
+
+    def drain_queue(self) -> None:
+        """Execute queued driver-side callables (Tune-report mechanism).
+
+        In-process analog of ``_handle_queue`` (``util.py:49-54``): with no
+        process boundary the driver *is* the worker, so thunks run as soon
+        as the trainer drains between batches.
+        """
+        if self.queue is None:
+            return
+        while True:
+            try:
+                (_rank, item) = self.queue.get_nowait()
+            except _queue.Empty:
+                return
+            if callable(item):
+                item()
+
+    def teardown_workers(self) -> None:
+        self.queue = None

@@ -1,0 +1,63 @@
+"""Launcher-shared plumbing: result schema, rendezvous helpers, executor seam.
+
+Parity with ``ray_lightning/launchers/utils.py``:
+
+- ``WorkerOutput`` ≙ ``_RayOutput`` (``launchers/utils.py:55-69``) — the
+  typed record rank 0 sends back to the driver.
+- ``find_free_port`` ≙ ``launchers/utils.py:12-17`` — probed on the worker
+  that will host the coordinator, not on the driver (the driver may not even
+  be on the cluster network, e.g. client mode).
+- ``get_executable_cls`` ≙ ``launchers/utils.py:20-24`` — test seam for
+  injecting fake executors.
+"""
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, NamedTuple, Optional
+
+
+class WorkerOutput(NamedTuple):
+    """What rank 0 returns to the driver after a launched stage.
+
+    Mirrors ``_RayOutput``: best checkpoint path, the final state as an
+    in-memory byte stream (multi-node safe — no shared filesystem assumed),
+    trainer progress counters, and metrics converted to host numpy.
+    """
+    best_model_path: Optional[str]
+    state_stream: Optional[bytes]
+    trainer_state: Dict[str, Any]
+    callback_metrics: Dict[str, Any]
+    logged_metrics: Dict[str, Any]
+    results: Any = None
+    callback_states: Optional[Dict[str, Any]] = None
+
+
+def find_free_port() -> int:
+    """Ask the OS for a free TCP port (coordinator rendezvous bootstrap)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        return s.getsockname()[1]
+
+
+def get_node_ip() -> str:
+    """Best-effort IP of this host (worker-side, for coordinator address)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+_executable_cls: Optional[type] = None
+
+
+def set_executable_cls(cls: Optional[type]) -> None:
+    """Install a custom executor class (test seam)."""
+    global _executable_cls
+    _executable_cls = cls
+
+
+def get_executable_cls() -> Optional[type]:
+    return _executable_cls

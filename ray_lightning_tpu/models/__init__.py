@@ -1,0 +1,8 @@
+from ray_lightning_tpu.models.boring import BoringModel, XORModel, XORDataModule
+from ray_lightning_tpu.models.mnist import (LightningMNISTClassifier,
+                                            MNISTClassifier)
+
+__all__ = [
+    "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
+    "MNISTClassifier"
+]

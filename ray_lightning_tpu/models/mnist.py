@@ -1,0 +1,102 @@
+"""MNIST classifier module, parity with ``tests/utils.py:99-148``.
+
+The reference's ``LightningMNISTClassifier`` is a 3-layer MLP (28²→128→256→10)
+with accuracy tracking. Same architecture here in flax; data is the
+synthetic learnable MNIST stand-in (zero-egress environment — see
+``ray_lightning_tpu/data/synthetic.py``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.data.loader import ArrayDataset, DataLoader
+from ray_lightning_tpu.data.synthetic import synthetic_mnist
+
+
+class MNISTNet(nn.Module):
+    hidden1: int = 128
+    hidden2: int = 256
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden1)(x))
+        x = nn.relu(nn.Dense(self.hidden2)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class LightningMNISTClassifier(TpuModule):
+    def __init__(self,
+                 config: Optional[dict] = None,
+                 data_dir: Optional[str] = None,
+                 num_samples: int = 2048):
+        super().__init__()
+        config = config or {}
+        self.lr = config.get("lr", 1e-3)
+        self.batch_size = int(config.get("batch_size", 32))
+        self.data_dir = data_dir
+        self.num_samples = num_samples
+
+    def configure_model(self):
+        return MNISTNet()
+
+    def configure_optimizers(self):
+        return optax.adam(self.lr)
+
+    def _dataset(self, seed: int):
+        x, y = synthetic_mnist(self.num_samples, seed=seed)
+        return ArrayDataset((x, y))
+
+    def train_dataloader(self):
+        return DataLoader(self._dataset(0), batch_size=self.batch_size,
+                          shuffle=True)
+
+    def val_dataloader(self):
+        return DataLoader(self._dataset(1), batch_size=self.batch_size)
+
+    def test_dataloader(self):
+        return DataLoader(self._dataset(2), batch_size=self.batch_size)
+
+    def predict_dataloader(self):
+        return DataLoader(self._dataset(3), batch_size=self.batch_size)
+
+    def init_variables(self, model, rng, batch):
+        return model.init(rng, batch[0])
+
+    def training_step(self, model, variables, batch, rng):
+        x, y = batch
+        logits = model.apply(variables, x)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, y))
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        self.log("ptl/train_loss", loss)
+        self.log("ptl/train_accuracy", acc)
+        return loss
+
+    def validation_step(self, model, variables, batch, rng):
+        x, y = batch
+        logits = model.apply(variables, x)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, y))
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return {"ptl/val_loss": loss, "ptl/val_accuracy": acc}
+
+    def test_step(self, model, variables, batch, rng):
+        x, y = batch
+        logits = model.apply(variables, x)
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return {"acc": acc}
+
+    def predict_step(self, model, variables, batch, rng):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return jnp.argmax(model.apply(variables, x), -1)
+
+
+MNISTClassifier = LightningMNISTClassifier

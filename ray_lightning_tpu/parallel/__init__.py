@@ -1,0 +1,12 @@
+from ray_lightning_tpu.parallel.mesh import (MeshSpec, build_mesh,
+                                             DP_AXIS, FSDP_AXIS, TP_AXIS,
+                                             SP_AXIS, PP_AXIS, EP_AXIS)
+from ray_lightning_tpu.parallel.sharding import (replicated, batch_sharding,
+                                                 shard_pytree_along_axis,
+                                                 largest_divisible_dim)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "DP_AXIS", "FSDP_AXIS", "TP_AXIS", "SP_AXIS",
+    "PP_AXIS", "EP_AXIS", "replicated", "batch_sharding",
+    "shard_pytree_along_axis", "largest_divisible_dim"
+]

@@ -1,0 +1,13 @@
+from ray_lightning_tpu.strategies.base import Strategy
+from ray_lightning_tpu.strategies.ddp import RayStrategy, DataParallelStrategy
+from ray_lightning_tpu.strategies.sharded import (RayShardedStrategy,
+                                                  ZeroOneStrategy)
+from ray_lightning_tpu.strategies.allreduce import (HorovodRayStrategy,
+                                                    AllReduceStrategy)
+from ray_lightning_tpu.strategies.fsdp import FSDPStrategy
+
+__all__ = [
+    "Strategy", "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
+    "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
+    "FSDPStrategy"
+]

@@ -1,0 +1,35 @@
+"""Data-parallel strategy — the flagship (RayStrategy parity).
+
+The reference's ``RayStrategy`` (``ray_lightning/ray_ddp.py:30-343``) wraps
+the model in ``DistributedDataParallel`` so NCCL all-reduces gradients each
+backward. TPU-native equivalent: parameters and optimizer state are
+**replicated** over a 1-D ``dp`` mesh, the batch is **sharded** over it, and
+XLA compiles the gradient ``psum`` into the step program, overlapping it with
+backprop compute over ICI — same semantics, no wrapper object, no per-step
+Python.
+"""
+from __future__ import annotations
+
+from ray_lightning_tpu.parallel.mesh import DP_AXIS, MeshSpec
+from ray_lightning_tpu.strategies.base import Strategy
+
+
+class RayStrategy(Strategy):
+    """Drop-in data-parallel strategy. ``num_workers`` = DP shards (chips).
+
+    Constructor parity: ``ray_ddp.py:76-126`` (``num_workers``,
+    ``num_cpus_per_worker``, ``use_gpu``/``use_tpu``, ``init_hook``,
+    ``resources_per_worker``, ``worker_runtime_env``). DDP kwargs such as
+    ``find_unused_parameters`` are accepted and ignored — dead-parameter
+    detection is static under XLA (unused params simply get zero gradients
+    from ``jax.grad``), so the failure mode the flag works around cannot
+    occur.
+    """
+    strategy_name = "ddp_ray"
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec({DP_AXIS: self.num_workers})
+
+
+# TPU-native alias: same object, name that says what it does.
+DataParallelStrategy = RayStrategy

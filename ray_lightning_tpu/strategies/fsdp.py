@@ -1,0 +1,32 @@
+"""Fully-sharded data parallelism (params + grads + optimizer state).
+
+Net-new beyond the reference's ZeRO-1 (`SURVEY.md` §2.3 marks FSDP as the
+TPU equivalent of FairScale's sharded training, `SURVEY.md` §2.2 row
+FairScale): every parameter and optimizer-state array is sharded along its
+largest divisible dim over the ``fsdp`` axis; XLA's SPMD partitioner
+all-gathers weights just-in-time per layer and reduce-scatters gradients,
+which is exactly the FSDP schedule, derived from annotations instead of
+hand-written hooks.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ray_lightning_tpu.parallel import sharding as shardlib
+from ray_lightning_tpu.parallel.mesh import FSDP_AXIS, MeshSpec
+from ray_lightning_tpu.strategies.base import Strategy
+
+
+class FSDPStrategy(Strategy):
+    strategy_name = "fsdp_tpu"
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec({FSDP_AXIS: self.num_workers})
+
+    def params_sharding(self, abstract_params: Any) -> Any:
+        return shardlib.shard_pytree_along_axis(
+            abstract_params, self.mesh, FSDP_AXIS)
+
+    def opt_state_sharding(self, abstract_opt_state: Any) -> Any:
+        return shardlib.shard_pytree_along_axis(
+            abstract_opt_state, self.mesh, FSDP_AXIS)

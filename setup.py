@@ -1,0 +1,16 @@
+"""Packaging, parity with the reference's ``setup.py`` (v0.3.0, 12 lines)."""
+from setuptools import find_packages, setup
+
+setup(
+    name="ray_lightning_tpu",
+    packages=find_packages(where=".", include="ray_lightning_tpu*"),
+    version="0.1.0",
+    author="",
+    description="TPU-native distributed training strategies with a "
+                "Ray-launchable SPMD trainer (jax/XLA/pallas)",
+    long_description="A TPU-native re-design of ray_lightning: drop-in "
+                     "Trainer strategies that run PyTorch-Lightning-style "
+                     "training as compiled SPMD programs over TPU meshes.",
+    url="https://github.com/ray-project/ray_lightning",
+    install_requires=["jax", "flax", "optax"],
+)

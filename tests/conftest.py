@@ -1,0 +1,41 @@
+"""Test environment: 8 virtual CPU devices standing in for an 8-chip slice.
+
+The reference simulates clusters with ``ray.init(num_cpus=2)`` fixtures and
+``ray.cluster_utils.Cluster`` (``tests/test_ddp.py:20-61``); the TPU-native
+analog is XLA's virtual host-platform devices: the same SPMD/sharding code
+paths compile and execute on 8 CPU "chips", so every mesh/collective test
+runs without TPU hardware. Must be configured before jax imports.
+"""
+import os
+
+# Disable the axon TPU plugin + force an 8-device virtual CPU platform.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize may have imported jax before this conftest ran, in
+# which case JAX_PLATFORMS was captured from the environment already — force
+# the config directly (backends are created lazily, so this is still early
+# enough as long as no test touched a device yet).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_session():
+    """Each test starts with no worker session installed."""
+    from ray_lightning_tpu import session
+    session.shutdown_session()
+    yield
+    session.shutdown_session()
+
+
+@pytest.fixture
+def tmp_root(tmp_path):
+    return str(tmp_path)
