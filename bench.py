@@ -1,0 +1,92 @@
+"""Benchmark: MNIST-classifier training throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); the driver-supplied north
+star tracks samples/sec/chip on MNIST (BASELINE.json "metric"). vs_baseline
+is measured against the recorded first-round value in BENCH_REFERENCE.json
+when present (so later rounds show relative progress), else 1.0.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+REFERENCE_FILE = os.path.join(os.path.dirname(__file__),
+                              "BENCH_REFERENCE.json")
+
+
+def bench_mnist(batch_size: int = 8192, steps: int = 30,
+                warmup: int = 5) -> float:
+    """Samples/sec/chip for the full jitted train step (fwd+bwd+adam)."""
+    import optax
+
+    from ray_lightning_tpu import RayStrategy
+    from ray_lightning_tpu.core.train_state import TrainState
+    from ray_lightning_tpu.models.mnist import MNISTNet
+    from ray_lightning_tpu.data.synthetic import synthetic_mnist
+
+    n_chips = len(jax.devices())
+    strategy = RayStrategy(num_workers=n_chips, use_tpu=True)
+    mesh = strategy.mesh
+
+    model = MNISTNet()
+    tx = optax.adam(1e-3)
+    x, y = synthetic_mnist(batch_size, seed=0)
+
+    def loss_fn(params, model_state, batch, rng):
+        bx, by = batch
+        logits = model.apply({"params": params}, bx)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, by).mean()
+        return loss, ({}, model_state)
+
+    def init_fn(rng):
+        params = model.init(rng, x[:1])["params"]
+        return TrainState.create(params, tx.init(params))
+
+    state_shardings = jax.tree_util.tree_map(
+        lambda _: strategy.scalar_sharding(),
+        jax.eval_shape(init_fn, jax.random.PRNGKey(0)))
+    state = jax.jit(init_fn, out_shardings=state_shardings)(
+        jax.random.PRNGKey(0))
+    step = strategy.make_train_step(loss_fn, tx, state_shardings,
+                                    strategy.batch_sharding())
+
+    batch = jax.device_put((x, y), strategy.batch_sharding())
+    for _ in range(warmup):
+        state, _ = step(state, batch)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _ = step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt / n_chips
+
+
+def main():
+    value = bench_mnist()
+    vs_baseline = 1.0
+    if os.path.exists(REFERENCE_FILE):
+        try:
+            with open(REFERENCE_FILE) as f:
+                ref = json.load(f)
+            if ref.get("value"):
+                vs_baseline = value / float(ref["value"])
+        except (json.JSONDecodeError, KeyError, ValueError):
+            pass
+    print(json.dumps({
+        "metric": "samples/sec/chip (MNIST MLP train step)",
+        "value": round(value, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

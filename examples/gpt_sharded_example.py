@@ -1,0 +1,52 @@
+"""Sharded large-model training example.
+
+Parity with the reference's ``examples/ray_ddp_sharded_example.py`` (ImageGPT
+with ``RayShardedStrategy`` + epoch-time/peak-memory callback): a GPT-2 model
+trained with ZeRO-1 optimizer-state sharding (or full FSDP with
+``--fsdp``), reporting per-epoch wall time and device memory.
+
+    python examples/gpt_sharded_example.py --num-workers 8 --size nano
+
+Use the virtual CPU mesh env (see mnist_ddp_example.py) off-TPU.
+"""
+import argparse
+
+from ray_lightning_tpu import (FSDPStrategy, RayShardedStrategy, Trainer)
+from ray_lightning_tpu.core.callbacks import EpochStatsCallback
+from ray_lightning_tpu.models import GPTModule
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=2)
+    parser.add_argument("--use-tpu", action="store_true", default=False)
+    parser.add_argument("--size", default="nano",
+                        choices=["nano", "small", "medium", "large", "xl"])
+    parser.add_argument("--fsdp", action="store_true", default=False,
+                        help="Fully-sharded params (ZeRO-3) instead of "
+                             "optimizer-state-only (ZeRO-1)")
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--max-epochs", type=int, default=2)
+    parser.add_argument("--smoke-test", action="store_true", default=False)
+    args = parser.parse_args()
+
+    strategy_cls = FSDPStrategy if args.fsdp else RayShardedStrategy
+    model = GPTModule(size=args.size, batch_size=args.batch_size,
+                      seq_len=args.seq_len,
+                      num_samples=4 * args.batch_size if args.smoke_test
+                      else 64 * args.batch_size)
+    trainer = Trainer(
+        strategy=strategy_cls(num_workers=args.num_workers,
+                              use_tpu=args.use_tpu),
+        max_epochs=1 if args.smoke_test else args.max_epochs,
+        callbacks=[EpochStatsCallback()],
+        enable_progress_bar=True,
+        seed=42)
+    trainer.fit(model)
+    print("callback_metrics:",
+          {k: round(float(v), 4) for k, v in trainer.callback_metrics.items()})
+
+
+if __name__ == "__main__":
+    main()

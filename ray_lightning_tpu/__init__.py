@@ -15,7 +15,8 @@ and the Trainer/module stack the reference borrows from PyTorch Lightning.
 from ray_lightning_tpu.strategies import (RayStrategy, DataParallelStrategy,
                                           RayShardedStrategy, ZeroOneStrategy,
                                           HorovodRayStrategy,
-                                          AllReduceStrategy, FSDPStrategy)
+                                          AllReduceStrategy, FSDPStrategy,
+                                          MeshStrategy)
 from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
                                     Callback, ModelCheckpoint,
                                     EpochStatsCallback, seed_everything)
@@ -25,6 +26,6 @@ __version__ = "0.1.0"
 __all__ = [
     "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
     "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
-    "FSDPStrategy", "Trainer", "TpuModule", "TpuDataModule", "Callback",
-    "ModelCheckpoint", "EpochStatsCallback", "seed_everything"
+    "FSDPStrategy", "MeshStrategy", "Trainer", "TpuModule", "TpuDataModule",
+    "Callback", "ModelCheckpoint", "EpochStatsCallback", "seed_everything"
 ]

@@ -57,11 +57,17 @@ def synthetic_images(num_samples: int = 1024,
 def synthetic_tokens(num_samples: int = 512,
                      seq_len: int = 128,
                      vocab_size: int = 1024,
-                     seed: int = 0) -> np.ndarray:
-    """Markov-ish token streams for LM training (next-token predictable)."""
+                     seed: int = 0,
+                     table_seed: int = 1234) -> np.ndarray:
+    """Markov-ish token streams for LM training (next-token predictable).
+
+    ``table_seed`` fixes the transition table so different ``seed`` splits
+    sample the same language.
+    """
     rng = np.random.default_rng(seed)
     # a sparse deterministic transition table makes next-token learnable
-    table = rng.integers(0, vocab_size, size=vocab_size)
+    table = np.random.default_rng(table_seed).integers(
+        0, vocab_size, size=vocab_size)
     toks = np.empty((num_samples, seq_len), dtype=np.int32)
     toks[:, 0] = rng.integers(0, vocab_size, size=num_samples)
     for t in range(1, seq_len):

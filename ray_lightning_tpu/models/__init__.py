@@ -1,8 +1,18 @@
 from ray_lightning_tpu.models.boring import BoringModel, XORModel, XORDataModule
 from ray_lightning_tpu.models.mnist import (LightningMNISTClassifier,
                                             MNISTClassifier)
+from ray_lightning_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM,
+                                                  TransformerEncoder)
+from ray_lightning_tpu.models.gpt import GPTModule, gpt2_config, count_params
+from ray_lightning_tpu.models.bert import BertModule, BertClassifier, bert_config
+from ray_lightning_tpu.models.resnet import (ResNetModule, resnet18,
+                                             resnet50)
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
-    "MNISTClassifier"
+    "MNISTClassifier", "TransformerConfig", "TransformerLM",
+    "TransformerEncoder", "GPTModule", "gpt2_config", "count_params",
+    "BertModule", "BertClassifier", "bert_config", "ResNetModule",
+    "resnet18", "resnet50"
 ]

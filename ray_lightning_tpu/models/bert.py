@@ -1,0 +1,141 @@
+"""BERT-base fine-tune module (BASELINE.json: "BERT-base fine-tune,
+RayStrategy multi-host (v4-32, 4 Ray actors)").
+
+Sequence-classification head over the shared bidirectional encoder; synthetic
+token data with class-dependent token distributions so fine-tuning is
+learnable in tests.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.data.loader import ArrayDataset, DataLoader
+from ray_lightning_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerEncoder)
+
+
+def bert_config(size: str = "base", vocab_size: int = 30522,
+                max_seq_len: int = 512, **overrides) -> TransformerConfig:
+    sizes = {
+        "tiny": (2, 128, 2),
+        "base": (12, 768, 12),    # 110M
+        "large": (24, 1024, 16),  # 340M
+    }
+    n_layers, d_model, n_heads = sizes[size]
+    base = dict(vocab_size=vocab_size, max_seq_len=max_seq_len,
+                d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+                d_ff=4 * d_model, causal=False, num_segments=2)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+class BertClassifier(nn.Module):
+    cfg: TransformerConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, deterministic=True):
+        x = TransformerEncoder(self.cfg, name="encoder")(
+            tokens, attention_mask=attention_mask,
+            deterministic=deterministic)
+        pooled = nn.tanh(nn.Dense(self.cfg.d_model, dtype=self.cfg.dtype,
+                                  name="pooler")(x[:, 0]))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)
+
+
+def _synthetic_classification_tokens(num_samples: int, seq_len: int,
+                                     vocab_size: int, num_classes: int,
+                                     seed: int):
+    """Class c draws tokens from a class-specific slice of the vocab."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=num_samples)
+    span = vocab_size // (num_classes + 1)
+    toks = np.empty((num_samples, seq_len), dtype=np.int32)
+    for i, c in enumerate(labels):
+        lo = (c + 1) * span
+        toks[i] = rng.integers(lo, lo + span, size=seq_len)
+    # mix in class-agnostic noise tokens
+    noise = rng.integers(0, span, size=(num_samples, seq_len))
+    noise_mask = rng.random((num_samples, seq_len)) < 0.5
+    toks = np.where(noise_mask, noise, toks)
+    return toks, labels.astype(np.int32)
+
+
+class BertModule(TpuModule):
+    def __init__(self,
+                 config: Optional[TransformerConfig] = None,
+                 size: str = "tiny",
+                 num_classes: int = 2,
+                 batch_size: int = 8,
+                 seq_len: Optional[int] = None,
+                 num_samples: int = 256,
+                 lr: float = 5e-5,
+                 vocab_size: int = 1024):
+        super().__init__()
+        if config is None:
+            seq_len = 128 if seq_len is None else seq_len
+            config = bert_config(size, vocab_size=vocab_size,
+                                 max_seq_len=seq_len)
+        self.cfg = config
+        seq_len = config.max_seq_len if seq_len is None else seq_len
+        if seq_len > config.max_seq_len:
+            raise ValueError(
+                f"seq_len={seq_len} exceeds config.max_seq_len="
+                f"{config.max_seq_len}; positions would silently clamp")
+        self.num_classes = num_classes
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.num_samples = num_samples
+        self.lr = lr
+
+    def configure_model(self):
+        return BertClassifier(self.cfg, self.num_classes)
+
+    def configure_optimizers(self):
+        return optax.adamw(self.lr, weight_decay=0.01)
+
+    def _loader(self, seed: int, shuffle: bool = False):
+        x, y = _synthetic_classification_tokens(
+            self.num_samples, self.seq_len, self.cfg.vocab_size,
+            self.num_classes, seed)
+        return DataLoader(ArrayDataset((x, y)), batch_size=self.batch_size,
+                          shuffle=shuffle)
+
+    def train_dataloader(self):
+        return self._loader(0, shuffle=True)
+
+    def val_dataloader(self):
+        return self._loader(1)
+
+    def test_dataloader(self):
+        return self._loader(2)
+
+    def init_variables(self, model, rng, batch):
+        return model.init(rng, batch[0])
+
+    def training_step(self, model, variables, batch, rng):
+        tokens, labels = batch
+        deterministic = self.cfg.dropout == 0.0
+        rngs = None if deterministic else {"dropout": rng}
+        logits = model.apply(variables, tokens,
+                             deterministic=deterministic, rngs=rngs)
+        loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels))
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        self.log("train_acc", acc)
+        return loss
+
+    def validation_step(self, model, variables, batch, rng):
+        tokens, labels = batch
+        logits = model.apply(variables, tokens, deterministic=True)
+        loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels))
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return {"val_loss": loss, "val_acc": acc}

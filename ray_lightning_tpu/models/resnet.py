@@ -1,0 +1,179 @@
+"""ResNet family (BASELINE.json configs: ResNet-18/CIFAR-10 DDP,
+ResNet-50 + Tune PBT).
+
+Flax implementation with BatchNorm — exercises the trainer's mutable
+``model_state`` (``batch_stats``) path end-to-end. NHWC layout (TPU-native
+conv layout); f32 by default, pass ``dtype=jnp.bfloat16`` to ``ResNetModule``
+for MXU-rate bf16 compute (params and batch stats stay f32 either way).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.data.loader import ArrayDataset, DataLoader
+from ray_lightning_tpu.data.synthetic import synthetic_images
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    conv: Any
+    norm: Any
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    conv: Any
+    norm: Any
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: Any
+    num_classes: int = 10
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+    small_images: bool = True  # CIFAR-style stem (3x3, no max-pool)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        if self.small_images:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    self.num_filters * 2 ** i, conv=conv, norm=norm,
+                    strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def resnet18(num_classes=10, **kw):
+    return ResNet([2, 2, 2, 2], ResNetBlock, num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes=10, **kw):
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes,
+                  **kw)
+
+
+class ResNetModule(TpuModule):
+    """CIFAR-10-style classification with BatchNorm state updates."""
+
+    def __init__(self,
+                 depth: int = 18,
+                 num_classes: int = 10,
+                 batch_size: int = 32,
+                 image_size: int = 32,
+                 num_samples: int = 512,
+                 lr: float = 0.1,
+                 momentum: float = 0.9,
+                 dtype: Any = jnp.float32,
+                 config: Optional[dict] = None):
+        super().__init__()
+        config = config or {}
+        self.depth = depth
+        self.num_classes = num_classes
+        self.batch_size = int(config.get("batch_size", batch_size))
+        self.image_size = image_size
+        self.num_samples = num_samples
+        self.lr = config.get("lr", lr)
+        self.momentum = config.get("momentum", momentum)
+        self.dtype = dtype
+
+    def configure_model(self):
+        factory = {18: resnet18, 50: resnet50}[self.depth]
+        return factory(self.num_classes, dtype=self.dtype)
+
+    def configure_optimizers(self):
+        return optax.sgd(self.lr, momentum=self.momentum, nesterov=True)
+
+    def _loader(self, seed: int, shuffle: bool = False):
+        x, y = synthetic_images(self.num_samples, self.num_classes,
+                                self.image_size, seed=seed)
+        return DataLoader(ArrayDataset((x, y)), batch_size=self.batch_size,
+                          shuffle=shuffle)
+
+    def train_dataloader(self):
+        return self._loader(0, shuffle=True)
+
+    def val_dataloader(self):
+        return self._loader(1)
+
+    def test_dataloader(self):
+        return self._loader(2)
+
+    def init_variables(self, model, rng, batch):
+        return model.init(rng, batch[0], train=False)
+
+    def training_step(self, model, variables, batch, rng):
+        x, y = batch
+        logits, mutated = model.apply(variables, x, train=True,
+                                      mutable=["batch_stats"])
+        loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, y))
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        self.log("train_acc", acc)
+        return loss, {}, mutated
+
+    def validation_step(self, model, variables, batch, rng):
+        x, y = batch
+        logits = model.apply(variables, x, train=False)
+        loss = jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, y))
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return {"val_loss": loss, "val_acc": acc}
+
+    def test_step(self, model, variables, batch, rng):
+        x, y = batch
+        logits = model.apply(variables, x, train=False)
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return {"acc": acc}

@@ -1,0 +1,221 @@
+"""Shared transformer core for the GPT/BERT model families.
+
+TPU-first choices baked in:
+
+- **bf16 compute, f32 params** (`TransformerConfig.dtype`): matmuls hit the
+  MXU at bf16 throughput; master weights and softmax stay f32.
+- **`nn.scan` over layers** (`scan_layers=True`): one compiled block program
+  reused L times — compile time stays flat as depth grows, and XLA pipelines
+  the layer loop.
+- **`nn.remat`** (`remat=True`): rematerialize block activations in backward,
+  trading MXU FLOPs for HBM — the standard memory lever for long sequences.
+- **Pluggable attention impl** (``attention_impl``): 'dot' (XLA-fused
+  reference), 'flash' (pallas blockwise kernel), 'ring' (sequence-parallel
+  ring attention over the ``sp`` mesh axis).
+
+Parameter-path naming is stable and load-bearing: tensor-parallel sharding
+rules (``MeshStrategy(param_rule=...)``) match on these names.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 1024
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16        # compute dtype
+    param_dtype: Any = jnp.float32   # master weights
+    causal: bool = True
+    scan_layers: bool = True
+    remat: bool = False
+    attention_impl: str = "dot"      # dot | flash | ring
+    tie_embeddings: bool = True
+    num_segments: int = 0            # >0 adds segment embeddings (BERT)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _attention_fn(cfg: TransformerConfig):
+    if cfg.attention_impl == "dot":
+        return dot_product_attention
+    if cfg.attention_impl == "flash":
+        from ray_lightning_tpu.ops.flash_attention import flash_attention
+        return flash_attention
+    if cfg.attention_impl == "ring":
+        from ray_lightning_tpu.parallel.ring_attention import ring_attention
+        return ring_attention
+    raise ValueError(f"Unknown attention_impl {cfg.attention_impl!r}")
+
+
+class MultiHeadAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        qkv = nn.DenseGeneral(
+            features=(3, cfg.n_heads, cfg.head_dim), axis=-1,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, 2, 0)  # 3 × (B, T, H, D)
+        drop_rng = None
+        if cfg.dropout > 0.0 and not deterministic:
+            drop_rng = self.make_rng("dropout")
+        attn = _attention_fn(cfg)
+        out = attn(q, k, v, causal=cfg.causal, mask=mask,
+                   dropout_rate=cfg.dropout if not deterministic else 0.0,
+                   dropout_rng=drop_rng)
+        out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
+        return nn.DenseGeneral(
+            features=cfg.d_model, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="out")(out)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="up")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="down")(h)
+        if cfg.dropout > 0.0 and not deterministic:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=False)
+        return h
+
+
+class TransformerBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        x = x + MultiHeadAttention(cfg, name="attn")(
+            h, mask=mask, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic)
+        return x
+
+
+class _ScanBlock(nn.Module):
+    """Block wrapper with carry-style signature for nn.scan.
+
+    ``deterministic`` is a static attribute (not part of the carry): scan
+    carries are traced arrays, and dropout gating must stay a Python bool.
+    """
+    cfg: TransformerConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, mask = carry
+        x = TransformerBlock(self.cfg, name="block")(
+            x, mask=mask, deterministic=self.deterministic)
+        return (x, mask), None
+
+
+class TransformerStack(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        cfg = self.cfg
+        if cfg.scan_layers:
+            block_cls = _ScanBlock
+            if cfg.remat:
+                block_cls = nn.remat(
+                    _ScanBlock, prevent_cse=False,
+                    static_argnums=())
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"})
+            (x, _), _ = stack(cfg, deterministic, name="layers")(
+                (x, mask), None)
+            return x
+        block_cls = TransformerBlock
+        if cfg.remat:
+            block_cls = nn.remat(TransformerBlock, prevent_cse=False)
+        for i in range(cfg.n_layers):
+            x = block_cls(cfg, name=f"block_{i}")(
+                x, mask=mask, deterministic=deterministic)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal language model (token + learned position embeds)."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.cfg
+        B, T = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="wte")
+        x = wte(tokens)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="wpe")(pos)
+        x = TransformerStack(cfg, name="stack")(
+            x, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = wte.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype,
+                              name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+class TransformerEncoder(nn.Module):
+    """BERT-style bidirectional encoder with optional segment embeddings."""
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None, segment_ids=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        B, T = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="tok_embed")(tokens)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="pos_embed")(pos)
+        if cfg.num_segments > 0 and segment_ids is not None:
+            x = x + nn.Embed(cfg.num_segments, cfg.d_model, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype,
+                             name="seg_embed")(segment_ids)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="embed_ln")(x)
+        mask = None
+        if attention_mask is not None:
+            big_neg = jnp.finfo(jnp.float32).min
+            mask = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             big_neg)
+        return TransformerStack(cfg, name="stack")(
+            x, mask=mask, deterministic=deterministic)

@@ -1,0 +1,4 @@
+from ray_lightning_tpu.ops.attention import dot_product_attention
+from ray_lightning_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["dot_product_attention", "flash_attention"]

@@ -1,0 +1,62 @@
+"""Attention ops: XLA-fused reference path + pallas flash-attention hook.
+
+The reference framework has no kernels of its own (its hot loop is torch
+DDP); a TPU-native framework owns its attention math. Two tiers:
+
+- :func:`dot_product_attention` — plain jnp einsum formulation. XLA already
+  fuses softmax chains well on TPU; this is the correctness baseline and the
+  CPU/test path.
+- :mod:`ray_lightning_tpu.ops.flash_attention` — blockwise online-softmax
+  attention (XLA loop), with the hand-tiled pallas kernel in
+  ``ops/pallas_flash.py``; chosen via ``TransformerConfig.attention_impl``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.float32) -> jax.Array:
+    """Additive causal mask of shape (1, 1, q_len, kv_len)."""
+    i = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+    offset = kv_len - q_len
+    allow = j <= i + offset
+    mask = jnp.where(allow, 0.0, jnp.finfo(dtype).min).astype(dtype)
+    return mask[None, None, :, :]
+
+
+def dot_product_attention(q: jax.Array,
+                          k: jax.Array,
+                          v: jax.Array,
+                          *,
+                          causal: bool = False,
+                          mask: Optional[jax.Array] = None,
+                          dropout_rate: float = 0.0,
+                          dropout_rng: Optional[jax.Array] = None,
+                          softmax_dtype=jnp.float32) -> jax.Array:
+    """Multi-head attention core. Shapes: (B, T, H, D) for q/k/v.
+
+    Softmax runs in ``softmax_dtype`` (f32) regardless of input dtype —
+    the standard bf16-safe formulation for the MXU.
+    """
+    *_, num_heads, head_dim = q.shape
+    del num_heads
+    scale = head_dim ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=softmax_dtype) * scale
+    if causal:
+        logits = logits + causal_mask(q.shape[1], k.shape[1],
+                                      dtype=softmax_dtype)
+    if mask is not None:
+        logits = logits + mask.astype(softmax_dtype)
+    weights = jax.nn.softmax(logits.astype(softmax_dtype), axis=-1)
+    weights = weights.astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v,
+                      preferred_element_type=q.dtype)

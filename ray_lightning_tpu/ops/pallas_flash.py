@@ -1,0 +1,132 @@
+"""Hand-tiled pallas flash-attention kernel for TPU.
+
+Grid ``(B, H, n_q, n_k)`` with the KV dimension innermost: for each query
+block the kernel streams KV blocks through VMEM, maintaining the online
+softmax state (running max ``m``, denominator ``l``, f32 accumulator) in
+scratch across grid steps, and writes the normalized output on the last KV
+block. Matmuls hit the MXU at the input dtype with f32 accumulation
+(``preferred_element_type``), per the TPU kernel guide.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, kv_len: int, q_len: int,
+                  block_q: int, block_k: int):
+    i = pl.program_id(2)   # q block
+    j = pl.program_id(3)   # kv block (innermost, sequential)
+    n_k = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _BIG_NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        qi = q_ref[0, 0]  # (bq, D)
+        kj = k_ref[0, 0]  # (bk, D)
+        vj = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            qi, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        allow = kpos < kv_len
+        if causal:
+            # align ends when q_len != kv_len (standard decode convention)
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (kv_len - q_len)
+            allow = allow & (kpos <= qpos)
+        s = jnp.where(allow, s, _BIG_NEG)
+
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(allow, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+        l_ref[:, 0] = l_new
+
+    if causal:
+        # predicate away KV blocks entirely above the diagonal (~2x FLOPs
+        # saved on the causal hot path; init/emit still run every step)
+        first_key = j * block_k
+        last_q = i * block_q + block_q - 1 + (kv_len - q_len)
+        pl.when(first_key <= last_q)(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == n_k - 1)
+    def _emit():
+        l = l_ref[:, 0]
+        safe_l = jnp.maximum(l, 1e-30)
+        out = jnp.where(l[:, None] > 0, acc_ref[:] / safe_l[:, None], 0.0)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def pallas_flash_attention(q: jax.Array,
+                           k: jax.Array,
+                           v: jax.Array,
+                           *,
+                           causal: bool = False,
+                           block_q: int = 128,
+                           block_k: int = 128,
+                           interpret: bool = False) -> jax.Array:
+    """Flash attention via pallas. Shapes (B, T, H, D), any T/S.
+
+    ``interpret=True`` runs the kernel in the pallas interpreter (CPU
+    testing path — same kernel code, no TPU required).
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    bq, bk = min(block_q, T), min(block_k, S)
+    n_q, n_k = -(-T // bq), -(-S // bk)
+    Tp, Sp = n_q * bq, n_k * bk
+
+    # (B,T,H,D) → (B,H,T,D): heads become a parallel grid dim, sequence
+    # tiles land on the (sublane, lane) layout the MXU wants.
+    qt = jnp.pad(q.transpose(0, 2, 1, 3),
+                 ((0, 0), (0, 0), (0, Tp - T), (0, 0)))
+    kt = jnp.pad(k.transpose(0, 2, 1, 3),
+                 ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+    vt = jnp.pad(v.transpose(0, 2, 1, 3),
+                 ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+
+    kernel = functools.partial(
+        _flash_kernel, scale=D ** -0.5, causal=causal, kv_len=S, q_len=T,
+        block_q=bq, block_k=bk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tp, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denom
+            pltpu.VMEM((bq, D), jnp.float32),   # f32 accumulator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out[:, :, :T].transpose(0, 2, 1, 3)

@@ -1,0 +1,84 @@
+"""Ring attention: sequence-parallel attention over the ``sp`` mesh axis.
+
+Net-new beyond the reference (SURVEY.md §5: long-context "entirely absent"),
+first-class here per the TPU design brief. Each of the N ``sp`` ranks holds a
+sequence shard of Q/K/V; K/V shards rotate around the ring via
+``lax.ppermute`` (ICI neighbor hops) for N steps while each rank accumulates
+online-softmax partial results for its local queries — attention over the
+full sequence with O(T/N) activation memory per chip and communication
+overlapped across steps.
+
+Must run inside ``shard_map`` with the ``sp`` axis bound (the
+SequenceParallelStrategy does this); called with no axis bound it falls back
+to plain attention, so models can enable ``attention_impl='ring'``
+unconditionally.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.ops.attention import dot_product_attention
+from ray_lightning_tpu.ops.flash_attention import (_BIG_NEG, _block_update,
+                                                   _finalize)
+
+SP_AXIS_NAME = "sp"
+
+
+def ring_attention(q: jax.Array,
+                   k: jax.Array,
+                   v: jax.Array,
+                   *,
+                   causal: bool = False,
+                   mask: Optional[jax.Array] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_rng: Optional[jax.Array] = None,
+                   axis_name: str = SP_AXIS_NAME,
+                   softmax_dtype=jnp.float32) -> jax.Array:
+    """Sequence-parallel attention. Local shapes (B, T_local, H, D).
+
+    Sequence positions are assumed contiguous per rank (rank r owns
+    ``[r*T_local, (r+1)*T_local)``), which is how the batch sharding lays
+    out a ``P(..., 'sp', ...)`` sequence dim.
+    """
+    del softmax_dtype
+    try:
+        my_rank = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+    except NameError:
+        return dot_product_attention(
+            q, k, v, causal=causal, mask=mask, dropout_rate=dropout_rate,
+            dropout_rng=dropout_rng)
+    if mask is not None or (dropout_rate > 0.0 and dropout_rng is not None):
+        raise NotImplementedError(
+            "ring_attention supports causal/full attention without "
+            "attention-dropout or custom masks; use attention_impl='dot' "
+            "for those.")
+
+    B, T_local, H, D = q.shape
+    scale = D ** -0.5
+    total = n * T_local
+    qpos = my_rank * T_local + jnp.arange(T_local)
+
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    def step(carry, t):
+        m, l, acc, kv = carry
+        kj, vj = kv
+        # at step t we hold the shard originally owned by rank (my - t) % n
+        src = jax.lax.rem(my_rank - t + n, n)
+        kpos = src * T_local + jnp.arange(T_local)
+        m, l, acc = _block_update((m, l, acc), q, kj, vj, qpos, kpos,
+                                  causal, total, scale)
+        # rotate kv to the next rank; overlap with the next step's compute
+        kv = jax.lax.ppermute((kj, vj), axis_name, perm)
+        return (m, l, acc, kv), None
+
+    init = (jnp.full((B, H, T_local), _BIG_NEG, jnp.float32),
+            jnp.zeros((B, H, T_local), jnp.float32),
+            jnp.zeros((B, T_local, H, D), jnp.float32),
+            (k, v))
+    (m, l, acc, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return _finalize(l, acc, q.dtype)

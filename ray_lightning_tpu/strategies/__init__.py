@@ -5,9 +5,10 @@ from ray_lightning_tpu.strategies.sharded import (RayShardedStrategy,
 from ray_lightning_tpu.strategies.allreduce import (HorovodRayStrategy,
                                                     AllReduceStrategy)
 from ray_lightning_tpu.strategies.fsdp import FSDPStrategy
+from ray_lightning_tpu.strategies.mesh_strategy import MeshStrategy
 
 __all__ = [
     "Strategy", "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
     "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
-    "FSDPStrategy"
+    "FSDPStrategy", "MeshStrategy"
 ]

@@ -1,0 +1,71 @@
+"""MeshStrategy (composite multi-axis) tests."""
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ray_lightning_tpu import MeshStrategy, RayStrategy
+from ray_lightning_tpu.models import BoringModel, LightningMNISTClassifier
+
+from utils import get_trainer
+
+
+def test_dp_fsdp_layout(tmp_root):
+    model = LightningMNISTClassifier(config={"batch_size": 32},
+                                     num_samples=256)
+    strategy = MeshStrategy(axes={"dp": 2, "fsdp": 4})
+    trainer = get_trainer(tmp_root, strategy=strategy, max_epochs=1,
+                          limit_train_batches=4, limit_val_batches=2,
+                          checkpoint_callback=False)
+    trainer.fit(model)
+    assert dict(trainer.mesh.shape) == {"dp": 2, "fsdp": 4}
+    assert strategy.world_size == 8
+    assert strategy.num_workers == 8
+    assert strategy.distributed_sampler_kwargs["num_replicas"] == 8
+    # params sharded along fsdp only (4 distinct shards over 8 devices)
+    big = max(jax.tree_util.tree_leaves(trainer.train_state.params),
+              key=lambda l: l.size)
+    assert not big.sharding.is_fully_replicated
+
+
+def test_mesh_matches_ddp(tmp_root):
+    def run(strategy):
+        model = BoringModel()
+        trainer = get_trainer(tmp_root, strategy=strategy, max_epochs=1,
+                              limit_train_batches=4, limit_val_batches=0,
+                              checkpoint_callback=False, seed=9)
+        trainer.fit(model)
+        return jax.device_get(trainer.train_state.params)
+
+    p_ddp = run(RayStrategy(num_workers=8))
+    p_mesh = run(MeshStrategy(axes={"dp": 2, "fsdp": 4}))
+    for a, b in zip(jax.tree_util.tree_leaves(p_ddp),
+                    jax.tree_util.tree_leaves(p_mesh)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_param_rule_tensor_layout(tmp_root):
+    """Custom param_rule drives explicit (tensor-parallel-style) layouts."""
+    def rule(path, leaf):
+        # shard every 2-D kernel's output dim over tp
+        if len(getattr(leaf, "shape", ())) == 2 and \
+                leaf.shape[1] % 2 == 0:
+            return P(None, "tp")
+        return P()
+
+    model = LightningMNISTClassifier(config={"batch_size": 32},
+                                     num_samples=128)
+    strategy = MeshStrategy(axes={"dp": 4, "tp": 2}, param_rule=rule)
+    trainer = get_trainer(tmp_root, strategy=strategy, max_epochs=1,
+                          limit_train_batches=2, limit_val_batches=0,
+                          checkpoint_callback=False)
+    trainer.fit(model)
+    kernels = [l for l in jax.tree_util.tree_leaves(
+        trainer.train_state.params) if l.ndim == 2]
+    assert any(not k.sharding.is_fully_replicated for k in kernels)
+
+
+def test_wildcard_axis(tmp_root):
+    strategy = MeshStrategy(axes={"dp": 2, "fsdp": -1})
+    assert dict(strategy.mesh.shape) == {"dp": 2, "fsdp": 4}
+    assert strategy.world_size == 8

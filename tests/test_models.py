@@ -1,0 +1,136 @@
+"""Model-family smoke + learning tests (BASELINE.json config coverage)."""
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import (FSDPStrategy, RayShardedStrategy, RayStrategy)
+from ray_lightning_tpu.models import (BertModule, GPTModule, ResNetModule,
+                                      count_params, gpt2_config)
+
+from utils import get_trainer
+
+
+def test_gpt_trains_loss_drops(tmp_root):
+    model = GPTModule(size="nano", batch_size=8, seq_len=64,
+                      num_samples=128, lr=1e-3)
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=2, limit_train_batches=16,
+                          limit_val_batches=4, checkpoint_callback=False)
+    trainer.fit(model)
+    val_loss = float(trainer.callback_metrics["val_loss"])
+    # random baseline is ln(1024) ≈ 6.93; markov structure must be learned
+    assert val_loss < 6.0, f"GPT did not learn: val_loss={val_loss}"
+
+
+def test_gpt_fsdp_sharded_params(tmp_root):
+    model = GPTModule(size="nano", batch_size=8, seq_len=64,
+                      num_samples=64)
+    trainer = get_trainer(tmp_root, strategy=FSDPStrategy(num_workers=4),
+                          max_epochs=1, limit_train_batches=4,
+                          limit_val_batches=0, checkpoint_callback=False)
+    trainer.fit(model)
+    sharded = [l for l in jax.tree_util.tree_leaves(
+        trainer.train_state.params) if not l.sharding.is_fully_replicated]
+    assert sharded
+
+
+def test_gpt_scan_vs_loop_equivalent(tmp_root):
+    """nn.scan over layers must be numerically identical to the python loop."""
+    def run(scan_layers):
+        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=64,
+                          scan_layers=scan_layers)
+        model = GPTModule(config=cfg, batch_size=4, seq_len=64,
+                          num_samples=32, lr=1e-3)
+        trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=1),
+                              max_epochs=1, limit_train_batches=2,
+                              limit_val_batches=1, checkpoint_callback=False,
+                              seed=0)
+        trainer.fit(model)
+        return float(trainer.callback_metrics["val_loss"])
+
+    # params init differs between layouts (per-layer rng split), so compare
+    # learned-loss magnitude rather than exact params
+    l_scan, l_loop = run(True), run(False)
+    assert abs(l_scan - l_loop) < 1.0
+
+
+def test_gpt_remat_matches(tmp_root):
+    """Remat changes memory, not math."""
+    def run(remat):
+        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=32,
+                          remat=remat)
+        model = GPTModule(config=cfg, batch_size=4, seq_len=32,
+                          num_samples=32, lr=1e-3)
+        trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                              max_epochs=1, limit_train_batches=3,
+                              limit_val_batches=0, checkpoint_callback=False,
+                              seed=1)
+        trainer.fit(model)
+        return jax.device_get(trainer.train_state.params)
+
+    p_base, p_remat = run(False), run(True)
+    for a, b in zip(jax.tree_util.tree_leaves(p_base),
+                    jax.tree_util.tree_leaves(p_remat)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_gpt2_param_counts():
+    """Size table sanity: gpt2-small ≈124M params."""
+    import jax.numpy as jnp
+    cfg = gpt2_config("small")
+    from ray_lightning_tpu.models import TransformerLM
+    model = TransformerLM(cfg)
+    toks = np.zeros((1, 8), dtype=np.int32)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), toks))
+    n = sum(int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(abstract["params"]))
+    assert 120e6 < n < 130e6, f"gpt2-small param count {n/1e6:.1f}M"
+
+
+def test_bert_trains(tmp_root):
+    model = BertModule(size="tiny", batch_size=16, seq_len=64,
+                       num_samples=512, lr=1e-3)
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=4, limit_train_batches=32,
+                          limit_val_batches=4, checkpoint_callback=False)
+    trainer.fit(model)
+    assert float(trainer.callback_metrics["val_acc"]) > 0.7
+
+
+def test_bert_sharded(tmp_root):
+    model = BertModule(size="tiny", batch_size=8, seq_len=32,
+                       num_samples=64)
+    trainer = get_trainer(tmp_root,
+                          strategy=RayShardedStrategy(num_workers=2),
+                          max_epochs=1, limit_train_batches=4,
+                          limit_val_batches=2, checkpoint_callback=False)
+    trainer.fit(model)
+    assert trainer.train_state is not None
+
+
+def test_resnet18_batchstats_update(tmp_root):
+    """BatchNorm running stats must actually move through the
+    (loss, logs, mutated_state) training_step path."""
+    model = ResNetModule(depth=18, batch_size=16, num_samples=128,
+                         lr=0.05)
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=1, limit_train_batches=6,
+                          limit_val_batches=2, checkpoint_callback=False)
+    trainer.fit(model)
+    bs = trainer.train_state.model_state.get("batch_stats")
+    assert bs is not None
+    means = [np.asarray(l) for l in jax.tree_util.tree_leaves(bs)]
+    assert any(np.abs(m).max() > 1e-6 for m in means), \
+        "batch_stats never updated"
+
+
+def test_resnet_learns(tmp_root):
+    model = ResNetModule(depth=18, batch_size=16, num_samples=256, lr=0.05)
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=3, limit_train_batches=16,
+                          limit_val_batches=4, checkpoint_callback=False)
+    trainer.fit(model)
+    assert float(trainer.callback_metrics["val_acc"]) > 0.5
