@@ -151,7 +151,8 @@ class Trainer:
             datamodule: Optional[TpuDataModule] = None,
             ckpt_path: Optional[str] = None) -> None:
         self.state = "fitting"
-        self._launcher = self.strategy.configure_launcher()
+        if self._launcher is None:
+            self._launcher = self.strategy.configure_launcher()
         output = self._launcher.launch(
             self._fit_worker, module, datamodule, ckpt_path, trainer=self)
         self._recover_results(output, module)
@@ -171,7 +172,8 @@ class Trainer:
                 datamodule: Optional[TpuDataModule] = None,
                 ckpt_path: Optional[str] = None) -> List[Any]:
         self.state = "predicting"
-        self._launcher = self.strategy.configure_launcher()
+        if self._launcher is None:
+            self._launcher = self.strategy.configure_launcher()
         output = self._launcher.launch(
             self._predict_worker, module, datamodule, ckpt_path, trainer=self)
         self.state = "finished"
@@ -180,7 +182,8 @@ class Trainer:
     def _run_evaluate(self, module, datamodule, ckpt_path,
                       stage: str) -> List[Dict[str, Any]]:
         self.state = f"{stage[:-1] if stage.endswith('e') else stage}ing"
-        self._launcher = self.strategy.configure_launcher()
+        if self._launcher is None:
+            self._launcher = self.strategy.configure_launcher()
         output = self._launcher.launch(
             self._evaluate_worker, module, datamodule, ckpt_path, stage,
             trainer=self)

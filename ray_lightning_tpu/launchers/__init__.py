@@ -1,4 +1,10 @@
 from ray_lightning_tpu.launchers.utils import WorkerOutput, find_free_port
 from ray_lightning_tpu.launchers.local import LocalLauncher
+from ray_lightning_tpu.launchers.ray_launcher import (ExecutorBase,
+                                                      RayLauncher,
+                                                      ray_available)
 
-__all__ = ["WorkerOutput", "find_free_port", "LocalLauncher"]
+__all__ = [
+    "WorkerOutput", "find_free_port", "LocalLauncher", "RayLauncher",
+    "ExecutorBase", "ray_available"
+]

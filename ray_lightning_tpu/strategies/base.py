@@ -89,10 +89,42 @@ class Strategy:
     def configure_launcher(self):
         """Install the launcher. Parity: ``ray_ddp.py:128-136``.
 
-        Local (single-process SPMD) by default; the Ray-backed multi-host
-        launcher substitutes itself here when a Ray cluster is attached.
+        Local (single-process SPMD) by default — one XLA process already
+        drives every chip on this host, so no actors are needed. When a Ray
+        cluster is attached (``ray.is_initialized()``), the Ray-backed
+        multi-host launcher takes over and schedules one executor actor per
+        TPU host, exactly where the reference always installs its
+        ``RayLauncher``.
         """
+        from ray_lightning_tpu.launchers import ray_launcher as _rl
+        ray = _rl._import_ray()
+        if ray is not None and ray.is_initialized():
+            return _rl.RayLauncher(self, ray_module=ray)
         return LocalLauncher(self)
+
+    def worker_setup(self, process_idx: int, num_processes: int = 1,
+                     coordinator_address: Optional[str] = None) -> None:
+        """Initialize this worker's distributed runtime, then ranks.
+
+        Parity seat of ``_worker_setup`` → ``init_process_group(env://)``
+        (``ray_ddp.py:171-213``): NCCL TCP-store rendezvous becomes
+        ``jax.distributed.initialize`` against the coordinator brokered by
+        the launcher; afterwards every process sees the global device mesh
+        and XLA collectives ride ICI/DCN. Single-process (local launcher or
+        fake actors) skips initialization — the local mesh is already whole.
+        """
+        if coordinator_address is not None and num_processes > 1:
+            try:
+                already = jax.distributed.is_initialized()  # jax >= 0.4.34
+            except AttributeError:
+                already = getattr(
+                    jax.distributed.global_state, "client", None) is not None
+            if not already:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_idx)
+        self.set_world_ranks(process_idx)
 
     # ------------------------------------------------------------------ #
     # mesh + sharding policy (the strategy-defining part)

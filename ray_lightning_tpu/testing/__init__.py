@@ -1,0 +1,12 @@
+"""Testing utilities: in-process fakes for the Ray substrate.
+
+The reference can test against real local Ray clusters
+(``ray.init(num_cpus=2)``, ``ray.cluster_utils.Cluster`` —
+``tests/test_ddp.py:20-61``); this package provides the equivalent seam for
+environments without Ray: a synchronous, pickling, ray-compatible fake that
+drives the full :class:`~ray_lightning_tpu.launchers.ray_launcher.RayLauncher`
+pipeline in-process.
+"""
+from ray_lightning_tpu.testing.fake_ray import FakeRay
+
+__all__ = ["FakeRay"]
