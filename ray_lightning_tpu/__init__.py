@@ -20,6 +20,7 @@ from ray_lightning_tpu.strategies import (RayStrategy, DataParallelStrategy,
 from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
                                     Callback, ModelCheckpoint,
                                     EpochStatsCallback, seed_everything)
+from ray_lightning_tpu.launchers import RayLauncher, LocalLauncher
 
 __version__ = "0.1.0"
 
@@ -27,5 +28,6 @@ __all__ = [
     "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
     "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
     "FSDPStrategy", "MeshStrategy", "Trainer", "TpuModule", "TpuDataModule",
-    "Callback", "ModelCheckpoint", "EpochStatsCallback", "seed_everything"
+    "Callback", "ModelCheckpoint", "EpochStatsCallback", "seed_everything",
+    "RayLauncher", "LocalLauncher"
 ]

@@ -90,18 +90,29 @@ class ExecutorBase:
     def get_node_and_chip_ids(self) -> Tuple[str, List[int]]:
         """(node ip, TPU chip ids visible to this actor).
 
-        Parity with ``get_node_and_gpu_ids`` (``launchers/utils.py:47-48``):
-        chip ids come from the Ray resource assignment (custom ``TPU``
-        resource) or, failing that, the local chip count.
+        Parity with ``get_node_and_gpu_ids`` (``launchers/utils.py:47-48``).
+        Chip *identity* matters (the per-node union dedupes by id), so ids
+        come from, in order: Ray's accelerator-id assignment (the analog of
+        ``ray.get_gpu_ids()``), an already-set ``TPU_VISIBLE_CHIPS`` env,
+        or the host's ``/dev/accel*`` device files (every chip on the host —
+        correct for the one-actor-per-host layout this launcher schedules).
         """
         ids: List[int] = []
         try:
             import ray
-            assigned = ray.get_runtime_context().get_assigned_resources()
-            n = int(assigned.get("TPU", 0))
-            ids = list(range(n))
+            acc = ray.get_runtime_context().get_accelerator_ids()
+            ids = [int(i) for i in acc.get("TPU", [])]
         except Exception:
             pass
+        if not ids:
+            env = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+            if env:
+                ids = [int(i) for i in env.split(",") if i.strip()]
+        if not ids:
+            import glob
+            ids = sorted(
+                int(p.rsplit("accel", 1)[1])
+                for p in glob.glob("/dev/accel[0-9]*"))
         return self.get_node_ip(), ids
 
     def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
@@ -187,8 +198,14 @@ class RayLauncher:
 
         self.queue = None
         if tune_enabled and self._in_tune_session():
-            from ray.util.queue import Queue
-            self.queue = Queue(actor_options={"num_cpus": 0})
+            try:
+                from ray.util.queue import Queue
+                self.queue = Queue(actor_options={"num_cpus": 0})
+            except ImportError:
+                # Fake-Ray (in-process) configuration: a thread queue gives
+                # the same put/get/empty surface the session requires.
+                import queue as _queue
+                self.queue = _queue.Queue()
 
     def _create_worker(self, rank: int):
         """One actor per TPU host. Parity: ``_create_worker``
@@ -266,11 +283,8 @@ class RayLauncher:
         return out
 
     def _in_tune_session(self) -> bool:
-        try:
-            from ray import tune
-            return tune.is_session_enabled()
-        except Exception:
-            return False
+        from ray_lightning_tpu.tune import is_session_enabled
+        return is_session_enabled()
 
     def run_function_on_workers(self, function: Callable, *args: Any,
                                 trainer=None, **kwargs: Any) -> Any:
@@ -359,7 +373,11 @@ class RayLauncher:
         while unfinished:
             if queue is not None:
                 self._drain_queue(queue)
-            _, unfinished = self._ray.wait(unfinished, timeout=0.05)
+            ready, unfinished = self._ray.wait(unfinished, timeout=0.05)
+            # Raise a failed worker's error NOW (reference util.py:62-63):
+            # peers blocked in a collective with the dead rank will never
+            # finish, so waiting for all futures first would hang forever.
+            self._ray.get(ready)
         if queue is not None:
             self._drain_queue(queue)
         return self._ray.get(futures)

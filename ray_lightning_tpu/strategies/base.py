@@ -112,7 +112,20 @@ class Strategy:
         the launcher; afterwards every process sees the global device mesh
         and XLA collectives ride ICI/DCN. Single-process (local launcher or
         fake actors) skips initialization — the local mesh is already whole.
+
+        When called without explicit arguments (an out-of-band worker, e.g.
+        a user-spawned process joining the job), the coordinator address and
+        world size fall back to the ``TL_COORDINATOR_ADDRESS`` /
+        ``TL_NUM_PROCESSES`` env vars the launcher broadcasts to every
+        actor — the same env-var rendezvous contract as the reference's
+        ``MASTER_ADDR``/``MASTER_PORT`` (``ray_launcher.py:160-176``).
         """
+        import os as _os
+        if coordinator_address is None:
+            coordinator_address = _os.environ.get("TL_COORDINATOR_ADDRESS")
+        if num_processes <= 1:
+            num_processes = int(_os.environ.get("TL_NUM_PROCESSES",
+                                                num_processes))
         if coordinator_address is not None and num_processes > 1:
             try:
                 already = jax.distributed.is_initialized()  # jax >= 0.4.34

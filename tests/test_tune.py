@@ -1,0 +1,160 @@
+"""Tune-integration tests against a fake ``tune`` module.
+
+The reference tests with real ``tune.run`` (``tests/test_tune.py:41-92``);
+without Ray installed, the same contracts are pinned here with a recording
+fake: one report per fired hook with the right values (the analog of
+``training_iteration == max_epochs``), checkpoint bytes written into the
+trial's checkpoint dir, sanity-phase and non-rank-0 suppression, and the
+bundle math behind ``get_tune_resources``.
+"""
+import contextlib
+import os
+
+import pytest
+
+import ray_lightning_tpu.tune as tune_mod
+from ray_lightning_tpu import RayStrategy, Trainer
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.tune import (TuneReportCallback,
+                                    TuneReportCheckpointCallback,
+                                    _trial_bundles, get_tune_resources)
+from ray_lightning_tpu.util import load_state_stream
+
+
+class FakeTune:
+    def __init__(self, tmpdir):
+        self.reports = []
+        self.tmpdir = tmpdir
+        self._ckpt_count = 0
+
+    def report(self, **metrics):
+        self.reports.append(metrics)
+
+    def is_session_enabled(self):
+        return True
+
+    @contextlib.contextmanager
+    def checkpoint_dir(self, step):
+        d = os.path.join(self.tmpdir, f"checkpoint_{step}")
+        os.makedirs(d, exist_ok=True)
+        self._ckpt_count += 1
+        yield d
+
+
+@pytest.fixture
+def fake_tune(tmp_path, monkeypatch):
+    fake = FakeTune(str(tmp_path))
+    monkeypatch.setattr(tune_mod, "tune", fake)
+    return fake
+
+
+# --------------------------------------------------------------------- #
+# bundle math (get_tune_resources parity, tune.py:32-56)
+# --------------------------------------------------------------------- #
+def test_trial_bundles_default():
+    bundles = _trial_bundles(2, 1, False, None, None)
+    assert bundles == [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}]
+
+
+def test_trial_bundles_tpu():
+    bundles = _trial_bundles(4, 2, False, True, None)
+    assert bundles[0] == {"CPU": 1}  # trial-driver head bundle
+    assert bundles[1:] == [{"CPU": 2, "TPU": 1}] * 4
+
+
+def test_trial_bundles_override_semantics():
+    """resources_per_worker CPU/TPU beat the dedicated args
+    (``ray_ddp.py:85-112`` semantics, tested like ``tests/test_ddp.py:138-176``)."""
+    bundles = _trial_bundles(1, 1, True, None, {
+        "CPU": 3, "TPU": 4, "extra": 1
+    })
+    assert bundles[1] == {"CPU": 3, "TPU": 4, "extra": 1}
+
+
+def test_get_tune_resources_requires_tune():
+    if tune_mod.TUNE_INSTALLED:
+        pytest.skip("ray.tune installed; Unavailable path not reachable")
+    with pytest.raises(RuntimeError, match="ray.tune"):
+        get_tune_resources(num_workers=2)
+
+
+# --------------------------------------------------------------------- #
+# report callback (tune.py:59-134 parity)
+# --------------------------------------------------------------------- #
+def test_report_each_epoch(fake_tune, tmp_path):
+    """One report per fired hook — the analog of the reference asserting
+    ``training_iteration == max_epochs`` per trial (``tests/test_tune.py:41-65``)."""
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=3,
+                      limit_train_batches=2, seed=0,
+                      default_root_dir=str(tmp_path),
+                      callbacks=[TuneReportCallback(on="train_epoch_end")])
+    trainer.fit(BoringModel())
+    assert len(fake_tune.reports) == 3
+    assert all("train_loss" in r for r in fake_tune.reports)
+
+
+def test_report_metric_mapping(fake_tune, tmp_path):
+    """dict metrics rename callback_metrics keys in the report."""
+    cb = TuneReportCallback(metrics={"objective": "train_loss"},
+                            on="train_epoch_end")
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=2, seed=0,
+                      default_root_dir=str(tmp_path), callbacks=[cb])
+    trainer.fit(BoringModel())
+    assert list(fake_tune.reports[0].keys()) == ["objective"]
+
+
+def test_invalid_hook_rejected():
+    with pytest.raises(ValueError, match="Invalid hook"):
+        TuneReportCallback(on="not_a_hook")
+
+
+def test_sanity_check_suppressed(fake_tune):
+    """Parity: ``tune.py:112-114`` — no reports during sanity checking."""
+    class T:
+        sanity_checking = True
+        global_rank = 0
+        callback_metrics = {"loss": 1.0}
+
+    cb = TuneReportCallback(on="validation_end")
+    cb.on_validation_end(T(), None)
+    assert fake_tune.reports == []
+
+
+def test_non_rank_zero_suppressed(fake_tune):
+    class T:
+        sanity_checking = False
+        global_rank = 1
+        callback_metrics = {"loss": 1.0}
+
+    cb = TuneReportCallback(on="validation_end")
+    cb.on_validation_end(T(), None)
+    assert fake_tune.reports == []
+
+
+# --------------------------------------------------------------------- #
+# checkpoint+report callback (tune.py:136-236 parity)
+# --------------------------------------------------------------------- #
+def test_checkpoint_and_report(fake_tune, tmp_path):
+    """Checkpoint bytes land in tune.checkpoint_dir on the driver and the
+    report follows, so Tune registers the checkpoint with the metrics."""
+    cb = TuneReportCheckpointCallback(on="train_epoch_end")
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=2,
+                      limit_train_batches=2, seed=0,
+                      default_root_dir=str(tmp_path), callbacks=[cb])
+    trainer.fit(BoringModel())
+    assert len(fake_tune.reports) == 2
+    assert fake_tune._ckpt_count == 2
+    # Last checkpoint is a loadable full trainer checkpoint.
+    last = os.path.join(str(tmp_path), "checkpoint_4", "checkpoint")
+    assert os.path.exists(last)
+    with open(last, "rb") as f:
+        ckpt = load_state_stream(f.read())
+    assert ckpt["global_step"] == 4
+    assert "state" in ckpt and "params" in ckpt["state"]
+
+
+def test_is_session_enabled_false_without_tune():
+    if tune_mod.TUNE_INSTALLED:
+        pytest.skip("ray.tune installed")
+    assert tune_mod.is_session_enabled() is False
