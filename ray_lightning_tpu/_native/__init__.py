@@ -1,0 +1,170 @@
+"""Native runtime components (C++), loaded through ctypes.
+
+The compute path is JAX/XLA; this package is the native layer *around* it —
+currently the shared-memory ring buffer backing multiprocess data loading
+(``shm_ring.cpp``). Compilation happens lazily on first use with the
+system ``g++`` and the resulting ``libtlnative.so`` is cached next to the
+sources; when no toolchain is available everything degrades to the pure-
+Python fallbacks in :mod:`ray_lightning_tpu.data` (set
+``TL_DISABLE_NATIVE=1`` to force that path).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "shm_ring.cpp")
+_LIB = os.path.join(_HERE, "libtlnative.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC,
+        "-o", _LIB, "-lrt"
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call. None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("TL_DISABLE_NATIVE"):
+            _load_failed = True
+            return None
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.tlshm_create.restype = ctypes.c_void_p
+        lib.tlshm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.tlshm_open.restype = ctypes.c_void_p
+        lib.tlshm_open.argtypes = [ctypes.c_char_p]
+        lib.tlshm_push.restype = ctypes.c_int
+        lib.tlshm_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_double
+        ]
+        lib.tlshm_peek.restype = ctypes.c_int64
+        lib.tlshm_peek.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.tlshm_pop.restype = ctypes.c_int64
+        lib.tlshm_pop.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double
+        ]
+        lib.tlshm_count.restype = ctypes.c_uint64
+        lib.tlshm_count.argtypes = [ctypes.c_void_p]
+        lib.tlshm_is_closed.restype = ctypes.c_int
+        lib.tlshm_is_closed.argtypes = [ctypes.c_void_p]
+        lib.tlshm_close.restype = None
+        lib.tlshm_close.argtypes = [ctypes.c_void_p]
+        lib.tlshm_destroy.restype = None
+        lib.tlshm_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load() is not None
+
+
+class ShmRing:
+    """Cross-process byte-message ring over POSIX shared memory.
+
+    Push/pop block GIL-free inside the native call, so a producer process
+    feeding batches overlaps fully with the consumer's device step. Messages
+    must be at most half the ring capacity (framing guarantee).
+    """
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(
+                "Native library unavailable (no g++, or TL_DISABLE_NATIVE "
+                "set); use the pure-Python loader path instead.")
+        self._lib = lib
+        self.name = name.encode() if isinstance(name, str) else name
+        if create:
+            self._h = lib.tlshm_create(self.name, capacity)
+        else:
+            self._h = lib.tlshm_open(self.name)
+        if not self._h:
+            raise OSError(
+                f"Could not {'create' if create else 'open'} shared-memory "
+                f"ring {name!r}")
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        return cls(name, create=False)
+
+    def push(self, data: bytes, timeout: float = 10.0) -> None:
+        rc = self._lib.tlshm_push(self._h, data, len(data), timeout)
+        if rc == -1:
+            raise TimeoutError("ring full")
+        if rc == -2:
+            raise BrokenPipeError("ring closed")
+        if rc == -3:
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds half the ring "
+                "capacity; enlarge the ring")
+
+    def pop(self, timeout: float = 10.0) -> Optional[bytes]:
+        """Next message, or None when the ring is closed and drained."""
+        size = self._lib.tlshm_peek(self._h, timeout)
+        if size == -2:
+            return None
+        if size == -1:
+            raise TimeoutError("ring empty")
+        buf = ctypes.create_string_buffer(int(size))
+        n = self._lib.tlshm_pop(self._h, buf, int(size), timeout)
+        if n == -2:
+            return None
+        if n == -1:
+            raise TimeoutError("ring empty")
+        if n < 0:
+            raise OSError(f"ring pop failed ({n})")
+        return buf.raw[:n]
+
+    def __len__(self) -> int:
+        return int(self._lib.tlshm_count(self._h))
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._lib.tlshm_is_closed(self._h))
+
+    def close(self) -> None:
+        self._lib.tlshm_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.tlshm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; explicit destroy() preferred
+        try:
+            self.destroy()
+        except Exception:
+            pass

@@ -1,3 +1,8 @@
 from ray_lightning_tpu.data.loader import DataLoader, ArrayDataset
+from ray_lightning_tpu.data.multiproc import (DevicePrefetcher,
+                                              MultiprocessDataLoader)
 
-__all__ = ["DataLoader", "ArrayDataset"]
+__all__ = [
+    "DataLoader", "ArrayDataset", "DevicePrefetcher",
+    "MultiprocessDataLoader"
+]

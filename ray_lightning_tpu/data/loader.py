@@ -71,6 +71,18 @@ class DataLoader:
         return math.floor(n) if self.drop_last else math.ceil(n)
 
     def __iter__(self) -> Iterator[Any]:
+        yield from self.iter_batches()
+        self._epoch += 1
+
+    def iter_batches(self, start: int = 0, step: int = 1) -> Iterator[Any]:
+        """Yield batches ``start, start+step, …`` of this epoch's sequence.
+
+        The strided-worker protocol used by
+        :class:`~ray_lightning_tpu.data.multiproc.MultiprocessDataLoader`:
+        each worker materializes *only its own* batches (the ``take`` copy
+        is the expensive part), so N workers do 1/N of the host work each
+        instead of filtering after assembly. Does not advance the epoch.
+        """
         n = len(self.dataset)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self._epoch)
@@ -79,7 +91,8 @@ class DataLoader:
             order = np.arange(n)
         stop = (n // self.batch_size) * self.batch_size if self.drop_last \
             else n
-        for start in range(0, stop, self.batch_size):
-            idx = order[start:start + self.batch_size]
+        starts = range(0, stop, self.batch_size)
+        for b in range(start, len(starts), step):
+            s = starts[b]
+            idx = order[s:s + self.batch_size]
             yield self.dataset.take(idx)
-        self._epoch += 1

@@ -198,12 +198,14 @@ class RayLauncher:
 
         self.queue = None
         if tune_enabled and self._in_tune_session():
-            try:
+            # Gate on the *injected* module: a fake-ray launcher must never
+            # spin up a real Ray queue actor even if ray is importable.
+            if getattr(self._ray, "__name__", "") == "ray":
                 from ray.util.queue import Queue
                 self.queue = Queue(actor_options={"num_cpus": 0})
-            except ImportError:
-                # Fake-Ray (in-process) configuration: a thread queue gives
-                # the same put/get/empty surface the session requires.
+            else:
+                # In-process fake: a thread queue gives the same
+                # put/get/empty surface the session requires.
                 import queue as _queue
                 self.queue = _queue.Queue()
 

@@ -102,7 +102,8 @@ class Strategy:
             return _rl.RayLauncher(self, ray_module=ray)
         return LocalLauncher(self)
 
-    def worker_setup(self, process_idx: int, num_processes: int = 1,
+    def worker_setup(self, process_idx: int,
+                     num_processes: Optional[int] = None,
                      coordinator_address: Optional[str] = None) -> None:
         """Initialize this worker's distributed runtime, then ranks.
 
@@ -121,11 +122,18 @@ class Strategy:
         ``MASTER_ADDR``/``MASTER_PORT`` (``ray_launcher.py:160-176``).
         """
         import os as _os
-        if coordinator_address is None:
+        # Env fallback only when the caller left BOTH at their defaults —
+        # an explicit num_processes=1 means "definitely single-process" and
+        # must never be overridden by stale TL_* vars.
+        if coordinator_address is None and num_processes is None:
             coordinator_address = _os.environ.get("TL_COORDINATOR_ADDRESS")
-        if num_processes <= 1:
-            num_processes = int(_os.environ.get("TL_NUM_PROCESSES",
-                                                num_processes))
+            try:
+                num_processes = int(
+                    _os.environ.get("TL_NUM_PROCESSES", "1"))
+            except ValueError:
+                num_processes = 1
+        if num_processes is None:
+            num_processes = 1
         if coordinator_address is not None and num_processes > 1:
             try:
                 already = jax.distributed.is_initialized()  # jax >= 0.4.34
@@ -270,6 +278,18 @@ class Strategy:
             if d.process_index == jax.process_index():
                 return d
         return jax.local_devices()[0]
+
+    @property
+    def accelerator_name(self) -> str:
+        """Parity: ``accelerator="_gpu" if use_gpu else "cpu"``
+        (``ray_ddp.py:122-123``) — the delayed variant so TPU-less drivers
+        can construct the trainer (client mode / CPU head node)."""
+        return "_tpu" if self.use_tpu else "cpu"
+
+    @property
+    def accelerator(self):
+        from ray_lightning_tpu.accelerators import resolve_accelerator
+        return resolve_accelerator(self.accelerator_name)
 
     @property
     def distributed_sampler_kwargs(self) -> Dict[str, int]:

@@ -1,0 +1,136 @@
+"""CLI + accelerator-shim tests.
+
+Parity targets: ``tests/test_lightning_cli.py:11-27`` (instantiate a
+strategy by name from CLI args, resolve ctor args incl. passthrough kwargs)
+and the ``_GPUAccelerator`` availability hack
+(``accelerators/delayed_gpu_accelerator.py:47-50``).
+"""
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.accelerators import (ACCELERATOR_REGISTRY,
+                                            CPUAccelerator,
+                                            DelayedTPUAccelerator,
+                                            TPUAccelerator,
+                                            resolve_accelerator)
+from ray_lightning_tpu.cli import (STRATEGY_REGISTRY, TpuLightningCLI,
+                                   _parse_value)
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.strategies import (FSDPStrategy, RayShardedStrategy,
+                                          RayStrategy)
+
+
+def test_strategy_registry_names():
+    assert STRATEGY_REGISTRY["ddp_ray"] is RayStrategy
+    assert STRATEGY_REGISTRY["ddp"] is RayStrategy
+    assert STRATEGY_REGISTRY["fsdp"] is FSDPStrategy
+    assert STRATEGY_REGISTRY["zero1"] is RayShardedStrategy
+
+
+def test_cli_builds_strategy_from_args():
+    """Parity: ``tests/test_lightning_cli.py:11-27`` — strategy ctor args
+    resolved from flags, including passthrough kwargs (the DDP-kwarg
+    analog: unknown keys land in ``extra_kwargs``)."""
+    cli = TpuLightningCLI(
+        BoringModel, run=False,
+        args=["fit", "--strategy", "ddp_ray",
+              "--strategy.num_workers", "2",
+              "--strategy.num_cpus_per_worker", "3",
+              "--strategy.bucket_cap_mb", "25",
+              "--trainer.max_epochs", "2"])
+    assert isinstance(cli.strategy, RayStrategy)
+    assert cli.strategy.num_workers == 2
+    assert cli.strategy.num_cpus_per_worker == 3
+    assert cli.strategy.extra_kwargs == {"bucket_cap_mb": 25}
+    assert cli.trainer.max_epochs == 2
+    assert isinstance(cli.model, BoringModel)
+
+
+def test_cli_equals_syntax_and_defaults():
+    cli = TpuLightningCLI(
+        BoringModel, run=False,
+        args=["--strategy.num_workers=4", "--model.batch_size=16"])
+    assert cli.subcommand == "fit"
+    assert cli.strategy.num_workers == 4
+    assert cli.model.batch_size == 16
+
+
+def test_cli_yaml_config(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "trainer:\n  max_epochs: 5\n"
+        "strategy:\n  name: fsdp\n  num_workers: 8\n"
+        "model:\n  batch_size: 4\n")
+    cli = TpuLightningCLI(BoringModel, run=False,
+                          args=["--config", str(cfg)])
+    assert isinstance(cli.strategy, FSDPStrategy)
+    assert cli.strategy.num_workers == 8
+    assert cli.trainer.max_epochs == 5
+    assert cli.model.batch_size == 4
+
+
+def test_cli_flag_overrides_config(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("trainer:\n  max_epochs: 5\n")
+    cli = TpuLightningCLI(BoringModel, run=False,
+                          args=["--config", str(cfg),
+                                "--trainer.max_epochs", "9"])
+    assert cli.trainer.max_epochs == 9
+
+
+def test_cli_unknown_strategy_errors():
+    with pytest.raises(SystemExit):
+        TpuLightningCLI(BoringModel, run=False,
+                        args=["--strategy", "nope"])
+
+
+def test_cli_run_fit(tmp_path):
+    cli = TpuLightningCLI(
+        BoringModel, run=True,
+        args=["fit", "--trainer.max_epochs", "1",
+              "--trainer.limit_train_batches", "2",
+              "--trainer.default_root_dir", str(tmp_path)])
+    assert cli.trainer.state == "finished"
+    assert cli.trainer.global_step == 2
+    assert np.isfinite(cli.trainer.callback_metrics["train_loss"])
+
+
+def test_parse_value_coercions():
+    assert _parse_value("3", 1) == 3
+    assert _parse_value("true", False) is True
+    assert _parse_value("0.5", 1.0) == 0.5
+    assert _parse_value("none", "x") is None
+    assert _parse_value("1e-3", None) == 1e-3
+    assert _parse_value("hello", None) == "hello"
+
+
+# --------------------------------------------------------------------- #
+# accelerators
+# --------------------------------------------------------------------- #
+def test_registry_contains_all():
+    assert set(ACCELERATOR_REGISTRY) >= {"cpu", "tpu", "_tpu"}
+
+
+def test_delayed_tpu_always_available():
+    """Parity: ``delayed_gpu_accelerator.py:47-50`` — the driver-side
+    availability check must pass with zero TPUs visible."""
+    assert DelayedTPUAccelerator.is_available() is True
+    # and setup_environment must not touch devices (no raise on CPU)
+    DelayedTPUAccelerator().setup_environment()
+
+
+def test_strict_tpu_unavailable_on_cpu():
+    assert TPUAccelerator.is_available() is False  # conftest pins cpu
+
+
+def test_delayed_tpu_raises_at_train_start_without_tpu():
+    """Parity: ``util.py:35-38`` — the deferred check fires in-worker."""
+    with pytest.raises(RuntimeError, match="no TPU"):
+        DelayedTPUAccelerator().on_train_start()
+
+
+def test_strategy_selects_delayed_tpu():
+    assert RayStrategy(num_workers=1, use_tpu=True).accelerator_name == \
+        "_tpu"
+    assert RayStrategy(num_workers=1).accelerator_name == "cpu"
+    assert isinstance(resolve_accelerator("cpu"), CPUAccelerator)
