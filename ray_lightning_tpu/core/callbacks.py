@@ -60,15 +60,21 @@ class ModelCheckpoint(Callback):
                  monitor: Optional[str] = None,
                  mode: str = "min",
                  save_top_k: int = 1,
-                 save_last: bool = False):
+                 save_last: bool = False,
+                 save_format: str = "stream"):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        if save_format not in ("stream", "orbax"):
+            raise ValueError(
+                f"save_format must be 'stream' or 'orbax', got "
+                f"{save_format!r}")
         self.dirpath = dirpath
         self.filename = filename
         self.monitor = monitor
         self.mode = mode
         self.save_top_k = save_top_k
         self.save_last = save_last
+        self.save_format = save_format
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self.last_model_path: str = ""
@@ -106,8 +112,9 @@ class ModelCheckpoint(Callback):
                 return
             monitor_val = float(np.asarray(raw))
             name = f"{name}-{self.monitor}={monitor_val:.4f}"
-        path = os.path.join(self.dirpath, name + ".ckpt")
-        trainer.save_checkpoint(path)
+        suffix = ".ckpt" if self.save_format == "stream" else ".orbax"
+        path = os.path.join(self.dirpath, name + suffix)
+        trainer.save_checkpoint(path, save_format=self.save_format)
         score = monitor_val if monitor_val is not None else \
             -float(trainer.global_step)  # no monitor: newest is best
         if self._is_better(score):
@@ -116,8 +123,10 @@ class ModelCheckpoint(Callback):
         self._saved.append((score, path))
         self._prune()
         if self.save_last:
-            self.last_model_path = os.path.join(self.dirpath, "last.ckpt")
-            trainer.save_checkpoint(self.last_model_path)
+            self.last_model_path = os.path.join(self.dirpath,
+                                                "last" + suffix)
+            trainer.save_checkpoint(self.last_model_path,
+                                    save_format=self.save_format)
 
     def _prune(self) -> None:
         if self.save_top_k < 0:
@@ -127,7 +136,11 @@ class ModelCheckpoint(Callback):
         while len(self._saved) > self.save_top_k:
             _score, path = self._saved.pop()
             if path != self.best_model_path and os.path.exists(path):
-                os.remove(path)
+                if os.path.isdir(path):  # orbax checkpoints are directories
+                    import shutil
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.remove(path)
 
     def state_dict(self) -> Dict[str, Any]:
         return {

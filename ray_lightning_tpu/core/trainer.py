@@ -651,14 +651,27 @@ class Trainer:
                 if st:
                     cb.load_state_dict(st)
 
-    def save_checkpoint(self, filepath: str) -> None:
-        """Dump a full resumable checkpoint (rank-0 only in multi-host)."""
+    def save_checkpoint(self, filepath: str,
+                        save_format: str = "stream") -> None:
+        """Dump a full resumable checkpoint.
+
+        ``save_format="stream"``: reference-parity byte-stream file
+        (consolidates to host — rank-0 only). ``save_format="orbax"``:
+        sharded directory checkpoint, every host writes its own shards —
+        see :mod:`ray_lightning_tpu.core.checkpoint`.
+        """
+        if save_format == "orbax":
+            from ray_lightning_tpu.core.checkpoint import \
+                save_sharded_checkpoint
+            ckpt = self.dump_checkpoint(consolidate=False)
+            save_sharded_checkpoint(filepath, ckpt, self.train_state)
+            return
         ckpt = self.dump_checkpoint()
         os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
         with open(filepath, "wb") as f:
             f.write(_util.to_state_stream(ckpt))
 
-    def dump_checkpoint(self) -> Dict[str, Any]:
+    def dump_checkpoint(self, consolidate: bool = True) -> Dict[str, Any]:
         module_state: Dict[str, Any] = {}
         if self._module is not None:
             self._module.on_save_checkpoint(module_state)
@@ -666,7 +679,8 @@ class Trainer:
             "epoch": self.current_epoch,
             "global_step": self.global_step,
             "state": serialization.to_state_dict(
-                jax.device_get(self.train_state)),
+                jax.device_get(self.train_state) if consolidate
+                else self.train_state),
             "callbacks": {
                 type(cb).__name__: cb.state_dict()
                 for cb in self.callbacks
@@ -678,8 +692,13 @@ class Trainer:
         return ckpt
 
     def _read_checkpoint(self, path: str) -> Dict[str, Any]:
-        with open(path, "rb") as f:
-            ckpt = _util.load_state_stream(f.read())
+        from ray_lightning_tpu.core.checkpoint import (
+            is_sharded_checkpoint, load_sharded_checkpoint)
+        if is_sharded_checkpoint(path):
+            ckpt = load_sharded_checkpoint(path)
+        else:
+            with open(path, "rb") as f:
+                ckpt = _util.load_state_stream(f.read())
         for cb in self.callbacks:
             cb.on_load_checkpoint(self, self._module, ckpt)
         return ckpt
