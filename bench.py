@@ -1,38 +1,107 @@
-"""Benchmark: MNIST-classifier training throughput per chip.
+"""Benchmark: training throughput per chip, with honesty guards.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
+The primary metric stays samples/sec/chip on the MNIST classifier train step
+(BASELINE.json "metric"); extras carry the BERT-base number, MFU for both,
+the virtual-mesh scaling proxy, and real-chip batch scaling.
 
-The reference publishes no numbers (BASELINE.md); the driver-supplied north
-star tracks samples/sec/chip on MNIST (BASELINE.json "metric"). vs_baseline
-is measured against the recorded first-round value in BENCH_REFERENCE.json
-when present (so later rounds show relative progress), else 1.0.
+Measurement design (the round-1 bench silently clamped a collapsed
+differential to 1e-9 s and recorded 2e14 samples/s — see VERDICT.md):
+
+- Differential timing: ``rate = extra_samples / (t(n_large) - t(n_small))``
+  where ``t(n)`` runs ``n`` chained train steps inside one compiled
+  ``fori_loop`` and ends with a host *fetch* of a value derived from the
+  final state. The chained state makes every timed call unique (nothing is
+  cacheable); the fetch defeats async dispatch. This removes the tunnel's
+  large fixed per-dispatch cost from the measurement.
+- Loud failure: the differential must be positive and exceed a floor far
+  above the clock resolution. If not, ``n_large`` doubles (bounded) and the
+  measurement retries; when retries run out a ``MeasurementError`` with a
+  diagnostic is raised — no number is ever printed from a collapsed timing.
+- Physical sanity: measured FLOP/s is bounded against the chip's peak
+  (device-kind table below); exceeding ~1.5x peak means the timing is wrong
+  and the bench fails. MFU is reported alongside samples/s.
+- ``BENCH_REFERENCE.json`` is written on the first valid run so
+  ``vs_baseline`` tracks progress across rounds.
 """
 from __future__ import annotations
 
 import json
+import math
 import os
+import subprocess
+import sys
 import time
+from functools import partial
 
-import jax
 import numpy as np
 
-REFERENCE_FILE = os.path.join(os.path.dirname(__file__),
-                              "BENCH_REFERENCE.json")
+HERE = os.path.dirname(os.path.abspath(__file__))
+REFERENCE_FILE = os.path.join(HERE, "BENCH_REFERENCE.json")
+
+# Peak bf16 matmul FLOP/s per chip by device kind (public spec sheets /
+# jax-ml.github.io/scaling-book). Used for the sanity bound and MFU.
+PEAK_BF16_FLOPS = {
+    "v2": 46e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+}
+# Nothing on earth does more than this on one chip today; absolute backstop
+# when the device kind is unknown (e.g. CPU children).
+ABS_MAX_FLOPS = 2e16
 
 
-def bench_mnist(batch_size: int = 8192, steps: int = 30,
-                warmup: int = 5) -> float:
-    """Samples/sec/chip for the full jitted train step (fwd+bwd+adam)."""
+class MeasurementError(RuntimeError):
+    """A throughput measurement that cannot be trusted. Never clamped."""
+
+
+def _chip_peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def _step_flops(step, state, batch) -> float | None:
+    """Per-step FLOPs from XLA's compiled cost analysis.
+
+    Caveat: loop bodies (``lax.scan``/``fori_loop``) are counted ONCE, so
+    scanned-layer transformers undercount by ~n_layers — those benches pass
+    an analytic count instead (``_transformer_train_flops``).
+    """
+    try:
+        cost = step.lower(state, batch).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def _transformer_train_flops(state, tokens_per_step: int) -> float:
+    """Standard analytic train-step FLOPs: 6 * params * tokens
+    (fwd 2NT + bwd 4NT; attention O(T^2) term negligible at short seq)."""
+    import jax
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
+    return 6.0 * n_params * tokens_per_step
+
+
+def _build_mnist_step(strategy, batch_size: int):
+    import jax
     import optax
 
-    from ray_lightning_tpu import RayStrategy
     from ray_lightning_tpu.core.train_state import TrainState
-    from ray_lightning_tpu.models.mnist import MNISTNet
     from ray_lightning_tpu.data.synthetic import synthetic_mnist
-
-    n_chips = len(jax.devices())
-    strategy = RayStrategy(num_workers=n_chips, use_tpu=True)
-    mesh = strategy.mesh
+    from ray_lightning_tpu.models.mnist import MNISTNet
 
     model = MNISTNet()
     tx = optax.adam(1e-3)
@@ -56,49 +125,274 @@ def bench_mnist(batch_size: int = 8192, steps: int = 30,
         jax.random.PRNGKey(0))
     step = strategy.make_train_step(loss_fn, tx, state_shardings,
                                     strategy.batch_sharding())
-
     batch = jax.device_put((x, y), strategy.batch_sharding())
+    return step, state, batch
 
-    # Chain `chunk` steps inside one compiled loop so the measurement is
-    # device throughput, not per-dispatch tunnel latency. Axon-tunnel
-    # honesty rules (see memory: axon-tpu-timing): block_until_ready may
-    # not actually block and identical repeated calls can be cached, so
-    # (a) the timed region ends with a host *fetch* of a value depending
-    # on the final state, and (b) every timed call gets a fresh chained
-    # state so nothing is repeatable or elidable.
-    from functools import partial
+
+def _build_bert_step(strategy, batch_size: int, seq_len: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_lightning_tpu.core.train_state import TrainState
+    from ray_lightning_tpu.models.bert import (BertClassifier, bert_config,
+                                               _synthetic_classification_tokens)
+
+    cfg = bert_config("base", vocab_size=30522, max_seq_len=seq_len,
+                      dtype=jnp.bfloat16)
+    model = BertClassifier(cfg, num_classes=2)
+    tx = optax.adamw(5e-5, weight_decay=0.01)
+    x, y = _synthetic_classification_tokens(batch_size, seq_len,
+                                            cfg.vocab_size, 2, seed=0)
+
+    def loss_fn(params, model_state, batch, rng):
+        tokens, labels = batch
+        logits = model.apply({"params": params}, tokens, deterministic=True)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, ({}, model_state)
+
+    def init_fn(rng):
+        params = model.init(rng, x[:1])["params"]
+        return TrainState.create(params, tx.init(params))
+
+    state_shardings = jax.tree_util.tree_map(
+        lambda _: strategy.scalar_sharding(),
+        jax.eval_shape(init_fn, jax.random.PRNGKey(0)))
+    state = jax.jit(init_fn, out_shardings=state_shardings)(
+        jax.random.PRNGKey(0))
+    step = strategy.make_train_step(loss_fn, tx, state_shardings,
+                                    strategy.batch_sharding())
+    batch = jax.device_put((x, y), strategy.batch_sharding())
+    return step, state, batch
+
+
+def _measure_rate(step, state, batch, samples_per_step: int,
+                  flops_per_step: float | None, peak_flops: float | None,
+                  floor_s: float = 0.25, max_doublings: int = 8,
+                  repeats: int = 3) -> dict:
+    """Trustworthy samples/s via differential chained-chunk timing.
+
+    Raises :class:`MeasurementError` instead of ever returning a value from
+    a collapsed or physically impossible timing.
+    """
+    import jax
+
+    resolution = time.get_clock_info("perf_counter").resolution
+    floor = max(floor_s, 1000.0 * resolution)
 
     @partial(jax.jit, static_argnames="n")
-    def run_chunk(state, batch, n):
-        def body(_, s):
-            s, _logs = step(s, batch)
-            return s
-        return jax.lax.fori_loop(0, n, body, state)
+    def run_chunk(s, b, n):
+        def body(_, acc):
+            nxt, _logs = step(acc, b)
+            return nxt
+        return jax.lax.fori_loop(0, n, body, s)
 
-    def timed(state, n):
-        float(np.asarray(state.step))  # sync before the clock starts
+    cell = {"state": state}
+    compiled: set = set()
+
+    def fetch():
+        leaf = jax.tree_util.tree_leaves(cell["state"].params)[0]
+        return float(jax.device_get(leaf.ravel()[0]))
+
+    def timed(n: int) -> float:
+        if n not in compiled:
+            cell["state"] = run_chunk(cell["state"], batch, n)
+            fetch()  # compile + execute outside the clock
+            compiled.add(n)
+        fetch()  # drain any pending work before the clock starts
         t0 = time.perf_counter()
-        state = run_chunk(state, batch, n)
-        _ = float(np.asarray(
-            jax.tree_util.tree_leaves(state.params)[0].ravel()[0]))
-        return time.perf_counter() - t0, state
+        cell["state"] = run_chunk(cell["state"], batch, n)
+        fetch()
+        return time.perf_counter() - t0
 
-    for _ in range(warmup):
-        state, _ = step(state, batch)
-    n_small, n_large = max(steps // 10, 5), steps
-    # compile both chunk sizes before timing
-    state = run_chunk(state, batch, n_small)
-    state = run_chunk(state, batch, n_large)
-    # Differential timing: the tunnel adds a large fixed per-dispatch cost,
-    # so rate = extra samples / extra time between a large and small chunk.
-    dt_small, state = timed(state, n_small)
-    dt_large, state = timed(state, n_large)
-    dt = max(dt_large - dt_small, 1e-9)
-    return batch_size * (n_large - n_small) / dt / n_chips
+    # Size the chunk from the model's FLOPs so the differential dwarfs
+    # dispatch noise on the first try: assume >= 10% of peak (or a slow
+    # CPU) and target ~2x the floor of pure device compute.
+    assumed = 0.10 * peak_flops if peak_flops else 2e9
+    if flops_per_step:
+        n_est = int(math.ceil(2.0 * floor * assumed / flops_per_step))
+    else:
+        n_est = 64
+    n_large = max(16, min(1 << (n_est - 1).bit_length(), 1 << 16))
+    n_small = max(2, n_large // 8)
+
+    history = []
+    for _ in range(max_doublings):
+        dt_small = min(timed(n_small) for _ in range(repeats))
+        dt_large = min(timed(n_large) for _ in range(repeats))
+        diff = dt_large - dt_small
+        history.append((n_small, n_large, dt_small, dt_large))
+        if diff > floor:
+            rate = samples_per_step * (n_large - n_small) / diff
+            flops_rate = (flops_per_step or 0.0) * rate / samples_per_step
+            if flops_rate > ABS_MAX_FLOPS:
+                raise MeasurementError(
+                    f"measured {flops_rate:.3e} FLOP/s exceeds the absolute "
+                    f"physical bound {ABS_MAX_FLOPS:.1e}; timing collapsed "
+                    f"(history={history})")
+            if peak_flops and flops_rate > 1.5 * peak_flops:
+                raise MeasurementError(
+                    f"measured {flops_rate:.3e} FLOP/s exceeds 1.5x chip "
+                    f"peak {peak_flops:.3e}; timing is wrong "
+                    f"(history={history})")
+            return {
+                "samples_per_sec": rate,
+                "steps_timed": n_large - n_small,
+                "dt": diff,
+                "mfu": (flops_rate / peak_flops
+                        if peak_flops and flops_per_step else None),
+                "flops_per_step": flops_per_step,
+            }
+        if n_large >= 1 << 20:
+            break
+        n_large *= 2
+    raise MeasurementError(
+        f"differential timing never exceeded the {floor:.3f}s floor after "
+        f"{len(history)} attempts (clock resolution {resolution:.1e}s); "
+        f"either the device elides work or dispatch noise dominates. "
+        f"history={history}")
 
 
-def main():
-    value = bench_mnist()
+def bench_model(build, samples_per_step: int, analytic_tokens: int = 0,
+                **build_kwargs) -> dict:
+    import jax
+
+    from ray_lightning_tpu import RayStrategy
+
+    n_chips = len(jax.devices())
+    strategy = RayStrategy(num_workers=n_chips, use_tpu=True)
+    step, state, batch = build(strategy, **build_kwargs)
+    if analytic_tokens:  # scanned-layer models: cost_analysis undercounts
+        flops = _transformer_train_flops(state, analytic_tokens)
+    else:
+        flops = _step_flops(step, state, batch)
+    peak = _chip_peak_flops(jax.devices()[0])
+    out = _measure_rate(step, state, batch, samples_per_step, flops, peak)
+    out["samples_per_sec_per_chip"] = out["samples_per_sec"] / n_chips
+    out["n_chips"] = n_chips
+    out["device_kind"] = jax.devices()[0].device_kind
+    return out
+
+
+# --------------------------------------------------------------------- #
+# scaling proxy: dp=8 vs dp=1 on a virtual CPU mesh, in subprocesses so
+# the platform forcing never touches the parent's TPU backend
+# --------------------------------------------------------------------- #
+def _scaling_child(dp: int) -> None:
+    import jax
+
+    from ray_lightning_tpu import RayStrategy
+
+    per_device_batch = 512
+    strategy = RayStrategy(num_workers=dp, use_tpu=False)
+    step, state, batch = _build_mnist_step(strategy,
+                                           per_device_batch * dp)
+    flops = _step_flops(step, state, batch)
+    out = _measure_rate(step, state, batch, per_device_batch * dp, flops,
+                        peak_flops=None, floor_s=0.15)
+    print(json.dumps({"dp": dp, "rate": out["samples_per_sec"],
+                      "devices": len(jax.devices())}))
+
+
+def _run_scaling_child(dp: int) -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the TPU tunnel out of the child
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["_TL_BENCH_MODE"] = f"scaling:{dp}"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True,
+                          timeout=900)
+    if proc.returncode != 0:
+        raise MeasurementError(
+            f"scaling child dp={dp} failed rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise MeasurementError(f"scaling child dp={dp} printed no JSON")
+
+
+def bench_scaling() -> dict:
+    """SPMD overhead proxy on a virtual 8-device CPU mesh (weak scaling).
+
+    With fewer host cores than mesh devices the virtual devices time-slice,
+    so the ideal dp=8 speedup is min(8, cores); efficiency is normalized by
+    that. On a 1-core host this still measures what the framework *adds*
+    (partitioning + collective overhead at equal compute capacity), which
+    is the regressable part; real ICI scaling needs real chips.
+    """
+    cores = os.cpu_count() or 1
+    r1 = _run_scaling_child(1)
+    r8 = _run_scaling_child(8)
+    ideal = float(min(8, cores))
+    return {
+        "proxy": "virtual 8-device CPU mesh, weak scaling (512 samples/dev)",
+        "host_cores": cores,
+        "dp1_samples_per_sec": r1["rate"],
+        "dp8_samples_per_sec": r8["rate"],
+        "ideal_speedup": ideal,
+        "efficiency": r8["rate"] / (r1["rate"] * ideal),
+    }
+
+
+def main() -> None:
+    mode = os.environ.get("_TL_BENCH_MODE", "")
+    if mode.startswith("scaling:"):
+        _scaling_child(int(mode.split(":", 1)[1]))
+        return
+
+    extras: dict = {}
+
+    mnist = bench_model(_build_mnist_step, samples_per_step=8192,
+                        batch_size=8192)
+    value = mnist["samples_per_sec_per_chip"]
+    extras["mnist"] = {
+        "samples_per_sec_per_chip": round(value, 1),
+        "mfu": round(mnist["mfu"], 4) if mnist["mfu"] else None,
+        "flops_per_step": mnist["flops_per_step"],
+        "device_kind": mnist["device_kind"],
+    }
+
+    try:
+        bert = bench_model(_build_bert_step, samples_per_step=32,
+                           analytic_tokens=32 * 128,
+                           batch_size=32, seq_len=128)
+        extras["bert_base"] = {
+            "samples_per_sec_per_chip": round(
+                bert["samples_per_sec_per_chip"], 2),
+            "mfu": round(bert["mfu"], 4) if bert["mfu"] else None,
+            "flops_per_step": bert["flops_per_step"],
+            "batch": 32, "seq_len": 128,
+        }
+    except Exception as exc:  # secondary benches degrade to a diagnostic
+        extras["bert_base"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        # batch scaling on the real chip: utilization growth small -> large
+        small = bench_model(_build_mnist_step, samples_per_step=1024,
+                            batch_size=1024)
+        extras["batch_scaling"] = {
+            "batch_1024_samples_per_sec": round(
+                small["samples_per_sec_per_chip"], 1),
+            "batch_8192_samples_per_sec": round(value, 1),
+            "speedup_8x_batch": round(
+                value / small["samples_per_sec_per_chip"], 3),
+        }
+    except Exception as exc:
+        extras["batch_scaling"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        extras["scaling"] = bench_scaling()
+    except Exception as exc:
+        extras["scaling"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     vs_baseline = 1.0
     if os.path.exists(REFERENCE_FILE):
         try:
@@ -108,11 +402,21 @@ def main():
                 vs_baseline = value / float(ref["value"])
         except (json.JSONDecodeError, KeyError, ValueError):
             pass
+    else:
+        with open(REFERENCE_FILE, "w") as f:
+            json.dump({
+                "metric": "samples/sec/chip (MNIST MLP train step)",
+                "value": round(value, 1),
+                "recorded": "first valid run (round 2)",
+                "extras": extras,
+            }, f, indent=2)
+
     print(json.dumps({
         "metric": "samples/sec/chip (MNIST MLP train step)",
         "value": round(value, 1),
         "unit": "samples/s/chip",
         "vs_baseline": round(vs_baseline, 3),
+        "extras": extras,
     }))
 
 

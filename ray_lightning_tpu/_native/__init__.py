@@ -26,16 +26,34 @@ _load_failed = False
 
 
 def _build() -> bool:
+    """Compile to a temp file and atomically rename into place.
+
+    Several processes on one host (ranks, trials) may race to build; the
+    rename guarantees no process ever ``CDLL``s a half-written .so, and the
+    caller holds an fcntl lock so only one process compiles.
+    """
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC,
-        "-o", _LIB, "-lrt"
+        "-o", tmp, "-lrt"
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-            FileNotFoundError):
+            FileNotFoundError, OSError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         return False
+
+
+def _needs_build() -> bool:
+    return not os.path.exists(_LIB) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB))
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -49,12 +67,24 @@ def load() -> Optional[ctypes.CDLL]:
         if os.environ.get("TL_DISABLE_NATIVE"):
             _load_failed = True
             return None
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            if not _build():
-                _load_failed = True
-                return None
+        if _needs_build():
+            # cross-process exclusion: one builder, everyone else waits
+            # then re-checks (the winner's rename makes the check false)
+            import fcntl
+            try:
+                lockf = open(f"{_LIB}.lock", "w")
+            except OSError:
+                lockf = None
+            try:
+                if lockf is not None:
+                    fcntl.flock(lockf, fcntl.LOCK_EX)
+                if _needs_build() and not _build():
+                    _load_failed = True
+                    return None
+            finally:
+                if lockf is not None:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+                    lockf.close()
         try:
             lib = ctypes.CDLL(_LIB)
         except OSError:

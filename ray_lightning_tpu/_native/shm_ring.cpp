@@ -252,16 +252,20 @@ int64_t tlshm_pop(void* handle, char* buf, uint64_t cap, double timeout_s) {
       return -1;
     }
   }
+  // Compute the wrap-gap retirement into locals and commit head/used only
+  // after the len<=cap check: a -4 return must leave the ring untouched so
+  // the caller can retry with a bigger buffer.
   uint64_t head = h->head;
   uint64_t len;
+  uint64_t gap = 0;
   if (h->capacity - head < 8) {  // tail gap too small for a marker
-    h->used -= h->capacity - head;
+    gap = h->capacity - head;
     head = 0;
     std::memcpy(&len, r->data, 8);
   } else {
     std::memcpy(&len, r->data + head, 8);
     if (len == WRAP_MARKER) {
-      h->used -= h->capacity - head;
+      gap = h->capacity - head;
       head = 0;
       std::memcpy(&len, r->data, 8);
     }
@@ -272,7 +276,7 @@ int64_t tlshm_pop(void* handle, char* buf, uint64_t cap, double timeout_s) {
   }
   std::memcpy(buf, r->data + head + 8, len);
   h->head = (head + frame_bytes(len)) % h->capacity;
-  h->used -= frame_bytes(len);
+  h->used -= gap + frame_bytes(len);
   h->n_messages -= 1;
   // Broadcast: several producers may fit in the space one pop frees.
   pthread_cond_broadcast(&h->not_full);

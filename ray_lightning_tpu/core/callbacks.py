@@ -92,9 +92,17 @@ class ModelCheckpoint(Callback):
                 score > self.best_model_score)
 
     def on_train_epoch_end(self, trainer, pl_module) -> None:
-        if trainer.global_rank != 0 or self.save_top_k == 0:
+        if self.save_top_k == 0:
             return
-        os.makedirs(self.dirpath, exist_ok=True)
+        # The orbax save is a *collective*: every jax.distributed process
+        # must join (each writes its own non-addressable shards and all
+        # meet at orbax's multihost sync barrier). Only the stream format —
+        # a rank-0 host consolidation — may be rank-gated. Decisions below
+        # (skip / filename) are computed identically on every rank from
+        # replicated metrics, so all ranks stay convergent.
+        collective = self.save_format == "orbax" and jax.process_count() > 1
+        if trainer.global_rank != 0 and not collective:
+            return
         name = self.filename.format(
             epoch=trainer.current_epoch, step=trainer.global_step)
         monitor_val = None
@@ -104,17 +112,27 @@ class ModelCheckpoint(Callback):
                 # PTL semantics: monitored metric absent this epoch (e.g.
                 # validation didn't run) ⇒ skip, never rank an unscored
                 # checkpoint against real scores.
-                import warnings
-                warnings.warn(
-                    f"ModelCheckpoint: monitored metric {self.monitor!r} "
-                    "not found in callback_metrics; skipping checkpoint "
-                    "this epoch.")
+                if trainer.global_rank == 0:
+                    import warnings
+                    warnings.warn(
+                        f"ModelCheckpoint: monitored metric "
+                        f"{self.monitor!r} not found in callback_metrics; "
+                        "skipping checkpoint this epoch.")
                 return
             monitor_val = float(np.asarray(raw))
             name = f"{name}-{self.monitor}={monitor_val:.4f}"
+        if trainer.global_rank == 0:
+            os.makedirs(self.dirpath, exist_ok=True)
         suffix = ".ckpt" if self.save_format == "stream" else ".orbax"
         path = os.path.join(self.dirpath, name + suffix)
         trainer.save_checkpoint(path, save_format=self.save_format)
+        if self.save_last:
+            last_path = os.path.join(self.dirpath, "last" + suffix)
+            trainer.save_checkpoint(last_path,
+                                    save_format=self.save_format)
+        if trainer.global_rank != 0:
+            return
+        # bookkeeping + pruning stay rank-0-only
         score = monitor_val if monitor_val is not None else \
             -float(trainer.global_step)  # no monitor: newest is best
         if self._is_better(score):
@@ -125,8 +143,6 @@ class ModelCheckpoint(Callback):
         if self.save_last:
             self.last_model_path = os.path.join(self.dirpath,
                                                 "last" + suffix)
-            trainer.save_checkpoint(self.last_model_path,
-                                    save_format=self.save_format)
 
     def _prune(self) -> None:
         if self.save_top_k < 0:

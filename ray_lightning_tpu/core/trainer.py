@@ -30,6 +30,7 @@ from flax import serialization
 
 from ray_lightning_tpu import util as _util
 from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
+from ray_lightning_tpu.parallel import sharding as shardlib
 from ray_lightning_tpu.core.module import TpuDataModule, TpuModule
 from ray_lightning_tpu.core.seed import seed_everything
 from ray_lightning_tpu.core.train_state import TrainState
@@ -261,7 +262,8 @@ class Trainer:
 
         sample_batch = self._cast_batch(sample_batch)
         batch_sharding = strategy.batch_sharding()
-        device_batch = jax.device_put(sample_batch, batch_sharding)
+        device_batch = shardlib.put_global_batch(sample_batch,
+                                                 batch_sharding)
 
         def init_fn(rng, batch):
             variables = module.init_variables(model, rng, batch)
@@ -456,7 +458,12 @@ class Trainer:
     def _eval_loop(self, loader, step_fn,
                    n_batches: int) -> Dict[str, Any]:
         logs_list: List[Dict[str, Any]] = []
-        rng = jax.random.PRNGKey(0)
+        # fold the training progress in so successive validation epochs see
+        # fresh randomness (round-1 review: a fixed key reused identical
+        # eval randomness every epoch), while staying run-deterministic
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed if self.seed is not None else 0),
+            self.global_step)
         for batch_idx, batch in enumerate(self._prefetch(loader, n_batches)):
             logs = step_fn(self.train_state, batch,
                            jax.random.fold_in(rng, batch_idx))
@@ -490,7 +497,7 @@ class Trainer:
         for batch in loader:
             if count >= n_batches:
                 break
-            buf.append(jax.device_put(
+            buf.append(shardlib.put_global_batch(
                 self._cast_batch(batch), self._batch_sharding))
             count += 1
             if len(buf) >= depth:
@@ -582,7 +589,7 @@ class Trainer:
         for batch_idx, batch in enumerate(loader):
             if batch_idx >= n:
                 break
-            batch = jax.device_put(
+            batch = shardlib.put_global_batch(
                 self._cast_batch(batch), self._batch_sharding)
             outs.append(jax.device_get(
                 predict_step(self.train_state, batch)))
@@ -595,6 +602,37 @@ class Trainer:
     # ------------------------------------------------------------------ #
     # results / checkpointing (worker↔driver contract)
     # ------------------------------------------------------------------ #
+    def _consolidated_state(self, collective: bool = False):
+        """Train state with every leaf host-fetchable on this process.
+
+        Multi-controller SPMD with sharded leaves (ZeRO/FSDP) cannot
+        ``device_get`` non-addressable shards. When every process reaches
+        this call at the same program point (``collective=True``, e.g. the
+        end-of-fit result collection), an all-gather replicates them first.
+        From rank-0-gated paths (stream ``ModelCheckpoint``, Tune
+        checkpoint thunks) a collective would deadlock the other ranks, so
+        sharded multi-process states fail loudly there instead — use
+        ``save_format="orbax"``, whose per-host shard writing exists for
+        exactly this. Single-process or fully-addressable states pass
+        through untouched.
+        """
+        state = self.train_state
+        if state is None or jax.process_count() == 1:
+            return state
+        if all(getattr(leaf, "is_fully_addressable", True)
+               for leaf in jax.tree_util.tree_leaves(state)):
+            return state
+        if not collective:
+            raise RuntimeError(
+                "Cannot consolidate a cross-process sharded train state "
+                "from a rank-0-only code path (the required all-gather is "
+                "a collective every process must join). Save sharded "
+                "multi-host states with save_format='orbax' instead of "
+                "the stream format.")
+        reps = jax.tree_util.tree_map(
+            lambda _: self.strategy.scalar_sharding(), state)
+        return jax.jit(lambda s: s, out_shardings=reps)(state)
+
     def _collect_rank_zero_results(self) -> WorkerOutput:
         """Parity: ``ray_launcher.py:313-350`` — best ckpt path, state as an
         in-memory byte stream, progress counters, numpy metrics."""
@@ -603,7 +641,9 @@ class Trainer:
         stream = None
         if self.strategy.is_remote:
             stream = _util.to_state_stream(
-                serialization.to_state_dict(self.train_state))
+                serialization.to_state_dict(
+                    jax.device_get(
+                        self._consolidated_state(collective=True))))
         return WorkerOutput(
             best_model_path=best_path,
             state_stream=stream,
@@ -679,7 +719,7 @@ class Trainer:
             "epoch": self.current_epoch,
             "global_step": self.global_step,
             "state": serialization.to_state_dict(
-                jax.device_get(self.train_state) if consolidate
+                jax.device_get(self._consolidated_state()) if consolidate
                 else self.train_state),
             "callbacks": {
                 type(cb).__name__: cb.state_dict()

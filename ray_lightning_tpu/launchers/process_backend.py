@@ -1,0 +1,323 @@
+"""Subprocess-backed execution backend: real OS processes, no Ray needed.
+
+The reference can only create worker processes through Ray actors; its
+multi-node correctness is nevertheless proven on one machine with
+``ray.cluster_utils.Cluster`` fakes (``tests/test_ddp.py:54-61``). This
+module is the TPU build's stronger analog — a **ray-compatible module**
+(``init/is_initialized/remote/put/get/wait/kill`` + the actor
+``.options().remote()`` / ``method.remote()`` protocol) whose actors are
+real spawned OS processes:
+
+- every argument and result crosses a genuine pickle boundary,
+- actors execute concurrently (one process each; calls on one actor are
+  FIFO, matching Ray actor semantics),
+- workers can run ``jax.distributed.initialize`` against a coordinator and
+  form a true multi-process XLA world — the rendezvous path that fakes
+  cannot exercise.
+
+Use it directly for Ray-less multi-process SPMD on one machine::
+
+    ray_mod = ProcessRay(worker_env={"JAX_PLATFORMS": "cpu"})
+    launcher = RayLauncher(strategy, ray_module=ray_mod)
+
+or let the test suite drive the full RayLauncher contract through it.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def _worker_main(conn, env: Dict[str, str]) -> None:
+    """Actor process body: apply env BEFORE anything initializes a backend,
+    then serve construct/call messages over the pipe until exit/EOF."""
+    os.environ.update(env)
+    actor = None
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        if kind == "exit":
+            try:
+                conn.close()
+            finally:
+                return
+        try:
+            if kind == "construct":
+                cls, args, kwargs = pickle.loads(msg[1])
+                actor = cls(*args, **kwargs)
+                conn.send(("ok", pickle.dumps(None)))
+            elif kind == "call":
+                name = msg[1]
+                args, kwargs = pickle.loads(msg[2])
+                result = getattr(actor, name)(*args, **kwargs)
+                conn.send(("ok", pickle.dumps(result)))
+            else:
+                conn.send(("err", pickle.dumps(
+                    RuntimeError(f"unknown message kind {kind!r}"))))
+        except BaseException as exc:  # noqa: BLE001 - must cross the pipe
+            try:
+                payload = pickle.dumps(exc)
+            except Exception:
+                payload = pickle.dumps(
+                    RuntimeError(traceback.format_exc()))
+            try:
+                conn.send(("err", payload))
+            except (BrokenPipeError, OSError):
+                return
+
+
+class ProcessFuture:
+    """Resolvable once; ``ProcessRay.get`` re-raises worker exceptions."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, value: Any = None,
+                 error: Optional[BaseException] = None) -> None:
+        self._value, self._error = value, error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("ProcessFuture not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class ProcessObjectRef:
+    """Driver-held ref; the object is re-pickled into each task's args
+    (matching Ray's resolve-top-level-refs-in-args semantics)."""
+
+    def __init__(self, value: Any):
+        self.value = value
+
+    def __repr__(self) -> str:
+        return f"ProcessObjectRef({type(self.value).__name__})"
+
+
+def _resolve_arg(obj: Any) -> Any:
+    if isinstance(obj, ProcessObjectRef):
+        return obj.value
+    if isinstance(obj, ProcessFuture):
+        return obj.result()
+    return obj
+
+
+class ProcessActorMethod:
+    def __init__(self, handle: "ProcessActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args: Any, **kwargs: Any) -> ProcessFuture:
+        return self._handle._submit(self._name, args, kwargs)
+
+
+class ProcessActorHandle:
+    """One spawned process per actor; FIFO call pipeline + reader thread."""
+
+    def __init__(self, cls: type, args: Tuple, kwargs: Dict,
+                 env: Dict[str, str]):
+        ctx = mp.get_context("spawn")  # fork-unsafe with a live XLA backend
+        self._conn, child_conn = ctx.Pipe()
+        self._proc = ctx.Process(target=_worker_main,
+                                 args=(child_conn, env), daemon=True)
+        self._proc.start()
+        child_conn.close()
+        self._send_lock = threading.Lock()
+        self._pending: List[ProcessFuture] = []
+        self._pending_lock = threading.Lock()
+        self._killed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        # construction is itself a pipelined call
+        fut = self._enqueue(
+            ("construct", pickle.dumps((cls, args, kwargs))))
+        fut.result(timeout=60)
+
+    def _enqueue(self, message: Tuple) -> ProcessFuture:
+        """Append the future and send its request atomically: the worker
+        replies FIFO, so pending order must equal send order even when
+        several driver threads submit concurrently."""
+        fut = ProcessFuture()
+        with self._send_lock:
+            with self._pending_lock:
+                self._pending.append(fut)
+            self._conn.send(message)
+        return fut
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                status, payload = self._conn.recv()
+            except (EOFError, OSError):
+                # process died: fail everything still in flight
+                err = RuntimeError(
+                    f"actor process pid={self._proc.pid} died "
+                    f"(exitcode={self._proc.exitcode})")
+                with self._pending_lock:
+                    pending, self._pending = self._pending, []
+                for fut in pending:
+                    fut._resolve(error=err)
+                return
+            with self._pending_lock:
+                fut = self._pending.pop(0)
+            if status == "ok":
+                fut._resolve(value=pickle.loads(payload))
+            else:
+                fut._resolve(error=pickle.loads(payload))
+
+    def _submit(self, name: str, args: Tuple,
+                kwargs: Dict) -> ProcessFuture:
+        if self._killed:
+            raise RuntimeError("Actor was killed")
+        args = tuple(_resolve_arg(a) for a in args)
+        kwargs = {k: _resolve_arg(v) for k, v in kwargs.items()}
+        return self._enqueue(("call", name, pickle.dumps((args, kwargs))))
+
+    def __getattr__(self, name: str) -> ProcessActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ProcessActorMethod(self, name)
+
+    def _kill(self) -> None:
+        self._killed = True
+        try:
+            with self._send_lock:
+                self._conn.send(("exit",))
+        except (BrokenPipeError, OSError):
+            pass
+        self._proc.join(timeout=5)
+        if self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=5)
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class ProcessRemoteClass:
+    def __init__(self, cls: type, backend: "ProcessRay"):
+        self._cls = cls
+        self._backend = backend
+        self._options: Dict[str, Any] = {}
+
+    def options(self, **options: Any) -> "ProcessRemoteClass":
+        out = ProcessRemoteClass(self._cls, self._backend)
+        out._options = options
+        return out
+
+    def remote(self, *args: Any, **kwargs: Any) -> ProcessActorHandle:
+        handle = ProcessActorHandle(self._cls, args, kwargs,
+                                    dict(self._backend.worker_env))
+        self._backend.created_actors.append(handle)
+        return handle
+
+
+class _ManagerQueue:
+    """Cross-process queue with the ray.util.queue.Queue surface the
+    launcher/session need (put/get/empty/shutdown)."""
+
+    def __init__(self, manager):
+        self._manager = manager
+        self._q = manager.Queue()
+
+    def put(self, item: Any) -> None:
+        self._q.put(item)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        return self._q.get(block, timeout)
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def shutdown(self) -> None:  # queue dies with the backend's manager
+        pass
+
+
+class ProcessRay:
+    """Ray-compatible module whose actors are spawned OS processes."""
+
+    ObjectRef = ProcessObjectRef
+
+    def __init__(self, worker_env: Optional[Dict[str, str]] = None,
+                 serialize_puts: bool = True):
+        self._initialized = False
+        self.worker_env = dict(worker_env or {})
+        self.serialize_puts = serialize_puts
+        self.created_actors: List[ProcessActorHandle] = []
+        self.killed_actors: List[ProcessActorHandle] = []
+        self._manager = None
+
+    # -- lifecycle ----------------------------------------------------- #
+    def init(self, *args: Any, **kwargs: Any) -> None:
+        self._initialized = True
+
+    def is_initialized(self) -> bool:
+        return self._initialized
+
+    def shutdown(self) -> None:
+        for actor in self.created_actors:
+            if not actor._killed:
+                actor._kill()
+        if self._manager is not None:
+            self._manager.shutdown()
+            self._manager = None
+        self._initialized = False
+
+    # -- object store -------------------------------------------------- #
+    def put(self, obj: Any) -> ProcessObjectRef:
+        if self.serialize_puts:
+            obj = pickle.loads(pickle.dumps(obj))
+        return ProcessObjectRef(obj)
+
+    def get(self, refs: Any, timeout: Optional[float] = None) -> Any:
+        if isinstance(refs, list):
+            return [_resolve_arg(r) if not isinstance(r, ProcessFuture)
+                    else r.result(timeout) for r in refs]
+        if isinstance(refs, ProcessFuture):
+            return refs.result(timeout)
+        return _resolve_arg(refs)
+
+    def wait(self, refs: List[Any], num_returns: int = 1,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[Any], List[Any]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [r for r in refs
+                     if not isinstance(r, ProcessFuture) or r.done()]
+            if len(ready) >= num_returns or (
+                    deadline is not None
+                    and time.monotonic() >= deadline):
+                not_ready = [r for r in refs if r not in ready]
+                return ready, not_ready
+            time.sleep(0.005)
+
+    # -- actors -------------------------------------------------------- #
+    def remote(self, cls: type) -> ProcessRemoteClass:
+        return ProcessRemoteClass(cls, self)
+
+    def kill(self, actor: ProcessActorHandle,
+             no_restart: bool = False) -> None:
+        actor._kill()
+        self.killed_actors.append(actor)
+
+    # -- launcher extension: cross-process tune queue ------------------- #
+    def make_queue(self) -> _ManagerQueue:
+        if self._manager is None:
+            self._manager = mp.get_context("spawn").Manager()
+        return _ManagerQueue(self._manager)

@@ -200,7 +200,12 @@ class RayLauncher:
         if tune_enabled and self._in_tune_session():
             # Gate on the *injected* module: a fake-ray launcher must never
             # spin up a real Ray queue actor even if ray is importable.
-            if getattr(self._ray, "__name__", "") == "ray":
+            make_queue = getattr(self._ray, "make_queue", None)
+            if make_queue is not None:
+                # backend provides its own cross-boundary queue (e.g. the
+                # subprocess backend's manager queue)
+                self.queue = make_queue()
+            elif getattr(self._ray, "__name__", "") == "ray":
                 from ray.util.queue import Queue
                 self.queue = Queue(actor_options={"num_cpus": 0})
             else:

@@ -100,20 +100,26 @@ def apply_rule(tree: Any, mesh: Mesh,
     return jax.tree_util.tree_map_with_path(_leaf, tree)
 
 
-def global_batch_to_host_local(global_batch: Any, mesh: Mesh) -> Any:
-    """Slice a host-global numpy batch down to this process's shard.
+def put_global_batch(batch: Any, sharding: Any) -> Any:
+    """Place a host-global batch onto a (possibly multi-process) mesh.
 
-    Multi-host helper: under multi-controller SPMD each process feeds only
-    the rows destined for its addressable devices.
-    ``jax.make_array_from_process_local_data`` then assembles the global
-    array. Single-process meshes pass through unchanged.
+    Single-process: plain ``device_put``. Multi-controller SPMD: every
+    process holds the same host-global batch (loaders are seeded
+    identically), and ``jax.make_array_from_callback`` transfers **only the
+    shards this process's devices own** — the per-host batch feeding the
+    reference gets from ``DistributedSampler`` (``ray_ddp.py:325-334``),
+    without N loaders needing rank-aware slicing. ``sharding`` may be a
+    single sharding (applied to every leaf) or a matching pytree.
     """
     if jax.process_count() == 1:
-        return global_batch
-    sharding = batch_sharding(mesh)
+        return jax.device_put(batch, sharding)
+    is_tree = not isinstance(sharding, jax.sharding.Sharding)
 
-    def _slice(x):
-        return jax.make_array_from_process_local_data(
-            sharding, np.asarray(x))
+    def _leaf(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, s,
+                                            lambda idx: x[idx])
 
-    return jax.tree_util.tree_map(_slice, global_batch)
+    if is_tree:
+        return jax.tree_util.tree_map(_leaf, batch, sharding)
+    return jax.tree_util.tree_map(lambda x: _leaf(x, sharding), batch)

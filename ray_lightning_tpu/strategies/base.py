@@ -38,6 +38,7 @@ class Strategy:
                  init_hook: Optional[Callable] = None,
                  resources_per_worker: Optional[Dict] = None,
                  worker_runtime_env: Optional[Dict] = None,
+                 use_ray: Optional[bool] = None,
                  **kwargs: Any):
         """Resource-spec semantics mirror ``ray_ddp.py:85-112``:
         ``resources_per_worker`` entries override the dedicated args —
@@ -74,6 +75,7 @@ class Strategy:
 
         self.additional_resources_per_worker = resources_per_worker
         self.init_hook = init_hook
+        self.use_ray = use_ray
         self.extra_kwargs = kwargs
 
         self._mesh: Optional[Mesh] = None
@@ -94,12 +96,23 @@ class Strategy:
         cluster is attached (``ray.is_initialized()``), the Ray-backed
         multi-host launcher takes over and schedules one executor actor per
         TPU host, exactly where the reference always installs its
-        ``RayLauncher``.
+        ``RayLauncher``. ``use_ray`` overrides the auto-detection both
+        ways: ``False`` keeps training local even inside a notebook that
+        happened to ``ray.init()`` for unrelated reasons (round-1 review:
+        silent escalation surprised exactly that case); ``True`` demands a
+        Ray cluster and fails loudly when none is attached.
         """
         from ray_lightning_tpu.launchers import ray_launcher as _rl
+        if self.use_ray is False:
+            return LocalLauncher(self)
         ray = _rl._import_ray()
         if ray is not None and ray.is_initialized():
             return _rl.RayLauncher(self, ray_module=ray)
+        if self.use_ray is True:
+            raise RuntimeError(
+                "use_ray=True but no Ray runtime is attached: install ray "
+                "and call ray.init() (or connect via ray.init('ray://...')) "
+                "before fit, or drop use_ray to train locally.")
         return LocalLauncher(self)
 
     def worker_setup(self, process_idx: int,

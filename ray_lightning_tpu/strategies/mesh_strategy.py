@@ -50,6 +50,14 @@ class MeshStrategy(Strategy):
 
     @property
     def world_size(self) -> int:
+        sizes = list(self._axes.values())
+        if -1 not in sizes:
+            # fixed axes: no device query — a client-mode driver (off the
+            # cluster, no TPUs) must be able to build strategy + trainer
+            # without ever touching jax.devices() (round-1 review: building
+            # the mesh here broke exactly that)
+            return math.prod(sizes)
+        # wildcard axis: resolved only where devices exist (worker side)
         return math.prod(self.mesh.shape[a] for a in self.mesh.axis_names)
 
     @property

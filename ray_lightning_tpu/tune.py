@@ -51,14 +51,74 @@ def _require_tune():
     return tune
 
 
+def _is_legacy_tune(tune_mod) -> bool:
+    """Ray 1.x exposes ``tune.is_session_enabled``; Ray 2.x removed it
+    along with ``tune.report(**kw)`` and ``tune.checkpoint_dir`` — the
+    presence of that attribute is the generation marker (ADVICE round 1:
+    silently assuming 1.x made the session queue never initialize and the
+    callbacks crash mid-trial on modern Ray)."""
+    return hasattr(tune_mod, "is_session_enabled")
+
+
 def is_session_enabled() -> bool:
-    """True when running inside a Tune trial process."""
+    """True when running inside a Tune trial process (any Ray generation)."""
     if tune is None:
         return False
+    if _is_legacy_tune(tune):
+        try:
+            return bool(tune.is_session_enabled())
+        except Exception:
+            return False
+    # Ray >= 2.x: a live train/tune session context marks the trial process.
     try:
-        return tune.is_session_enabled()
+        from ray.train._internal.session import _get_session
+        if _get_session() is not None:
+            return True
+    except Exception:
+        pass
+    try:
+        ctx = tune.get_context()
+        return ctx is not None and ctx.get_trial_id() is not None
     except Exception:
         return False
+
+
+def _report(tune_mod, metrics: Dict[str, Any],
+            checkpoint_dir: Optional[str] = None) -> None:
+    """Version-adaptive report: legacy kwargs API vs 2.x dict(+checkpoint).
+
+    Runs *in the trial process* (shipped through the session queue).
+    """
+    if _is_legacy_tune(tune_mod):
+        tune_mod.report(**metrics)
+        return
+    train_mod = None
+    try:
+        from ray import train as _train
+        if hasattr(_train, "report"):
+            train_mod = _train
+    except ImportError:
+        pass
+    checkpoint = None
+    if checkpoint_dir is not None:
+        ckpt_cls = getattr(train_mod, "Checkpoint", None) or \
+            getattr(tune_mod, "Checkpoint", None)
+        if ckpt_cls is None:
+            raise RuntimeError(
+                "Cannot register the trial checkpoint: the installed ray "
+                "exposes neither ray.train.Checkpoint nor "
+                "ray.tune.Checkpoint. Upgrade ray[tune] or drop the "
+                "checkpoint callback.")
+        checkpoint = ckpt_cls.from_directory(checkpoint_dir)
+    if train_mod is not None:
+        train_mod.report(metrics, checkpoint=checkpoint)
+    elif hasattr(tune_mod, "report"):
+        tune_mod.report(metrics, checkpoint=checkpoint)
+    else:
+        raise RuntimeError(
+            "No compatible Tune report API found: the installed ray has "
+            "neither the legacy `tune.report(**kw)` nor `ray.train.report` "
+            "/ `ray.tune.report(metrics, checkpoint=...)`.")
 
 
 def _trial_bundles(
@@ -179,7 +239,7 @@ class TuneReportCallback(Callback):
         if report is None:
             return
         tune_mod = _require_tune()
-        session.put_queue(lambda: tune_mod.report(**report))
+        session.put_queue(lambda: _report(tune_mod, report))
 
 
 class _TuneCheckpointCallback(Callback):
@@ -202,12 +262,33 @@ class _TuneCheckpointCallback(Callback):
 
     @staticmethod
     def _create_checkpoint(tune_mod, stream: bytes, global_step: int,
-                           filename: str) -> None:
-        with tune_mod.checkpoint_dir(step=global_step) as checkpoint_dir:
-            with open(os.path.join(checkpoint_dir, filename), "wb") as f:
-                f.write(stream)
+                           filename: str,
+                           report: Optional[Dict[str, Any]] = None) -> None:
+        """Write the checkpoint in the trial process (queue thunk).
 
-    def _checkpoint(self, trainer) -> None:
+        Legacy Ray: bytes land in ``tune.checkpoint_dir(step)`` (parity
+        ``tune.py:161-178``); an optional report follows. Ray >= 2.x has no
+        standalone checkpoint registration — the checkpoint can only enter
+        Tune attached to a report, so both travel in one ``train.report``.
+        """
+        if _is_legacy_tune(tune_mod):
+            with tune_mod.checkpoint_dir(step=global_step) as checkpoint_dir:
+                with open(os.path.join(checkpoint_dir, filename), "wb") as f:
+                    f.write(stream)
+            if report is not None:
+                _report(tune_mod, report)
+            return
+        import tempfile
+        with tempfile.TemporaryDirectory() as tmpdir:
+            with open(os.path.join(tmpdir, filename), "wb") as f:
+                f.write(stream)
+            _report(tune_mod,
+                    report if report is not None
+                    else {"checkpoint_step": global_step},
+                    checkpoint_dir=tmpdir)
+
+    def _checkpoint(self, trainer,
+                    report: Optional[Dict[str, Any]] = None) -> None:
         if trainer.sanity_checking or trainer.global_rank != 0:
             return
         tune_mod = _require_tune()
@@ -215,7 +296,7 @@ class _TuneCheckpointCallback(Callback):
         global_step = trainer.global_step
         session.put_queue(
             lambda: self._create_checkpoint(tune_mod, stream, global_step,
-                                            self._filename))
+                                            self._filename, report))
 
     def on_fit_start(self, trainer, pl_module):
         if "fit_start" in self._on:
@@ -258,8 +339,20 @@ class TuneReportCheckpointCallback(Callback):
         self._report_cb = TuneReportCallback(metrics, on)
 
     def _fan(self, hook: str, trainer, pl_module) -> None:
-        getattr(self._checkpoint_cb, hook)(trainer, pl_module)
-        getattr(self._report_cb, hook)(trainer, pl_module)
+        if trainer.global_rank != 0:
+            return
+        tune_mod = _require_tune()
+        if _is_legacy_tune(tune_mod):
+            # legacy: checkpoint first, then report, as two queue thunks —
+            # the report registers the just-written checkpoint_dir
+            getattr(self._checkpoint_cb, hook)(trainer, pl_module)
+            getattr(self._report_cb, hook)(trainer, pl_module)
+            return
+        # Ray >= 2.x: checkpoint + metrics must travel in ONE report call
+        if not any(hook == "on_" + h for h in self._checkpoint_cb._on):
+            return
+        report = self._report_cb._get_report_dict(trainer, pl_module)
+        self._checkpoint_cb._checkpoint(trainer, report=report or {})
 
     def on_fit_start(self, trainer, pl_module):
         self._fan("on_fit_start", trainer, pl_module)

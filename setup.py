@@ -11,6 +11,6 @@ setup(
     long_description="A TPU-native re-design of ray_lightning: drop-in "
                      "Trainer strategies that run PyTorch-Lightning-style "
                      "training as compiled SPMD programs over TPU meshes.",
-    url="https://github.com/ray-project/ray_lightning",
+    url="https://github.com/ray-lightning-tpu/ray_lightning_tpu",
     install_requires=["jax", "flax", "optax"],
 )

@@ -27,6 +27,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "multiproc: spawns real OS processes (slower)")
+    config.addinivalue_line(
+        "markers", "tpu: requires a real TPU chip (opt-in: TL_TPU_TESTS=1)")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_session():
     """Each test starts with no worker session installed."""

@@ -184,3 +184,26 @@ def test_seed_determinism(tmp_root):
     for a, b in zip(jax.tree_util.tree_leaves(p1),
                     jax.tree_util.tree_leaves(p2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_use_ray_false_stays_local(monkeypatch):
+    """Explicit opt-out: an attached Ray runtime must NOT hijack the launch
+    (round-1 review: notebooks that ray.init() for unrelated reasons)."""
+    from ray_lightning_tpu.launchers import ray_launcher as rl
+    from ray_lightning_tpu.launchers.local import LocalLauncher
+    from ray_lightning_tpu.testing.fake_ray import FakeRay
+
+    fake = FakeRay()
+    fake.init()
+    monkeypatch.setattr(rl, "_import_ray", lambda: fake)
+    strategy = RayStrategy(num_workers=1, use_ray=False)
+    assert isinstance(strategy.configure_launcher(), LocalLauncher)
+
+
+def test_use_ray_true_without_cluster_raises(monkeypatch):
+    from ray_lightning_tpu.launchers import ray_launcher as rl
+
+    monkeypatch.setattr(rl, "_import_ray", lambda: None)
+    strategy = RayStrategy(num_workers=1, use_ray=True)
+    with pytest.raises(RuntimeError, match="use_ray=True"):
+        strategy.configure_launcher()

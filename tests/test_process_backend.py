@@ -1,0 +1,157 @@
+"""Multi-process SPMD execution tests — real OS processes, real rendezvous.
+
+The analog of the reference's ``ray.cluster_utils.Cluster`` two-node tests
+(``ray_lightning/tests/test_ddp.py:54-61``): the subprocess-backed
+``ProcessRay`` module drives the UNMODIFIED ``RayLauncher`` pipeline with
+every actor a spawned OS process, so these tests execute what no in-process
+fake can:
+
+- the ``jax.distributed.initialize`` coordinator handshake between two XLA
+  processes (``strategies/base.py:worker_setup``),
+- a cross-process global device mesh + sharded batch feeding,
+- true concurrent actor dispatch, and a real pickle boundary for every
+  argument (trainer included).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import RayStrategy, Trainer
+from ray_lightning_tpu.launchers.process_backend import ProcessRay
+from ray_lightning_tpu.launchers.ray_launcher import RayLauncher
+from ray_lightning_tpu.models import BoringModel
+
+# Children must form their own 1-device-per-process CPU worlds: drop the
+# parent's 8-virtual-device flag, keep the TPU tunnel disabled.
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
+def _make_backend():
+    return ProcessRay(worker_env=dict(WORKER_ENV))
+
+
+def _fit_with_process_backend(num_workers: int, tmp_path, seed: int = 0):
+    ray_mod = _make_backend()
+    ray_mod.init()
+    strategy = RayStrategy(num_workers=num_workers)
+    trainer = Trainer(strategy=strategy, max_epochs=2, seed=seed,
+                      limit_train_batches=4, limit_val_batches=0,
+                      default_root_dir=str(tmp_path))
+    trainer._launcher = RayLauncher(strategy, ray_module=ray_mod)
+    model = BoringModel(batch_size=8)
+    try:
+        trainer.fit(model)
+    finally:
+        ray_mod.shutdown()
+    return trainer
+
+
+@pytest.mark.multiproc
+def test_two_process_rendezvous_and_fit(tmp_path):
+    """2 OS processes rendezvous via jax.distributed, form a 2-device global
+    mesh, fit, and return rank-0 results through the full launcher contract.
+    """
+    trainer = _fit_with_process_backend(2, tmp_path)
+    assert trainer.global_step == 8  # 2 epochs x 4 batches
+    assert "train_loss" in trainer.callback_metrics
+    # remote fit with no driver template leaves the raw state dict
+    state = trainer.train_state_dict
+    assert state is not None and "params" in state
+
+
+@pytest.mark.multiproc
+def test_two_process_fit_matches_single_process(tmp_path):
+    """Numerical equivalence: dp=2 across two processes == single-process
+    training on the same global batches (identical params in *both*
+    processes is implied: params are replicated by out_shardings, and the
+    returned rank-0 copy must equal the deterministic local run)."""
+    remote = _fit_with_process_backend(2, tmp_path / "remote")
+
+    local_strategy = RayStrategy(num_workers=1)
+    local = Trainer(strategy=local_strategy, max_epochs=2, seed=0,
+                    limit_train_batches=4, limit_val_batches=0,
+                    default_root_dir=str(tmp_path / "local"))
+    local.fit(BoringModel(batch_size=8))
+
+    remote_params = remote.train_state_dict["params"]
+    local_params = local.train_state.params
+
+    import jax
+    remote_leaves = jax.tree_util.tree_leaves(remote_params)
+    local_leaves = [np.asarray(x)
+                    for x in jax.tree_util.tree_leaves(local_params)]
+    assert len(remote_leaves) == len(local_leaves)
+    for r, l in zip(remote_leaves, local_leaves):
+        np.testing.assert_allclose(np.asarray(r), l, atol=1e-5)
+
+
+class ExplodingModel(BoringModel):
+    """Module-level (must pickle into the worker process)."""
+
+    def prepare_data(self):
+        raise RuntimeError("boom in worker")
+
+
+@pytest.mark.multiproc
+def test_worker_exception_fails_fast(tmp_path):
+    """A worker raising must surface on the driver (fail-fast fault model,
+    parity ``util.py:57-70``), not hang the launch."""
+    ray_mod = _make_backend()
+    ray_mod.init()
+    strategy = RayStrategy(num_workers=2)
+    trainer = Trainer(strategy=strategy, max_epochs=1, seed=0,
+                      limit_train_batches=2, limit_val_batches=0,
+                      default_root_dir=str(tmp_path))
+    trainer._launcher = RayLauncher(strategy, ray_module=ray_mod)
+    try:
+        with pytest.raises(RuntimeError, match="boom in worker"):
+            trainer.fit(ExplodingModel(batch_size=8))
+    finally:
+        ray_mod.shutdown()
+
+
+def _sleep_and_pid(seconds: float):
+    time.sleep(seconds)
+    return os.getpid()
+
+
+@pytest.mark.multiproc
+def test_actors_execute_concurrently():
+    """Round-1 gap: the fake backend was synchronous, so concurrent dispatch
+    was never covered. Two process actors sleeping 1s each must finish in
+    well under 2s, in distinct processes."""
+    ray_mod = _make_backend()
+    ray_mod.init()
+    try:
+        from ray_lightning_tpu.launchers.ray_launcher import ExecutorBase
+        actors = [ray_mod.remote(ExecutorBase).remote() for _ in range(2)]
+        t0 = time.perf_counter()
+        futures = [a.execute.remote(_sleep_and_pid, 1.0) for a in actors]
+        pids = ray_mod.get(futures)
+        dt = time.perf_counter() - t0
+        assert dt < 1.8, f"actors ran serially ({dt:.2f}s for 2x 1s sleeps)"
+        assert len(set(pids)) == 2
+        assert os.getpid() not in pids
+    finally:
+        ray_mod.shutdown()
+
+
+@pytest.mark.multiproc
+def test_args_cross_real_pickle_boundary():
+    """Every execute() argument crosses pickle (round-1 gap: fake args did
+    not), so unpicklables fail here exactly as they would on a cluster."""
+    ray_mod = _make_backend()
+    ray_mod.init()
+    try:
+        from ray_lightning_tpu.launchers.ray_launcher import ExecutorBase
+        actor = ray_mod.remote(ExecutorBase).remote()
+        with pytest.raises(Exception):
+            ray_mod.get(actor.execute.remote(lambda x: x, 1))  # lambda
+    finally:
+        ray_mod.shutdown()
