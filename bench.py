@@ -267,7 +267,11 @@ def bench_model(build, samples_per_step: int, analytic_tokens: int = 0,
         flops = _transformer_train_flops(state, analytic_tokens)
     else:
         flops = _step_flops(step, state, batch)
-    peak = _chip_peak_flops(jax.devices()[0])
+    # The step runs over the whole mesh: the sanity bound and MFU must use
+    # the mesh's aggregate peak, not one chip's, or any multi-chip host
+    # fails the bound at >1.5/n_chips per-chip utilization.
+    chip_peak = _chip_peak_flops(jax.devices()[0])
+    peak = chip_peak * n_chips if chip_peak else None
     out = _measure_rate(step, state, batch, samples_per_step, flops, peak)
     out["samples_per_sec_per_chip"] = out["samples_per_sec"] / n_chips
     out["n_chips"] = n_chips

@@ -619,7 +619,10 @@ class Trainer:
         state = self.train_state
         if state is None or jax.process_count() == 1:
             return state
+        # Fully-replicated leaves (default DP) are host-fetchable even when
+        # not fully addressable: the local shard holds the whole value.
         if all(getattr(leaf, "is_fully_addressable", True)
+               or getattr(leaf, "is_fully_replicated", False)
                for leaf in jax.tree_util.tree_leaves(state)):
             return state
         if not collective:

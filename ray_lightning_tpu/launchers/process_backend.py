@@ -287,8 +287,18 @@ class ProcessRay:
 
     def get(self, refs: Any, timeout: Optional[float] = None) -> Any:
         if isinstance(refs, list):
-            return [_resolve_arg(r) if not isinstance(r, ProcessFuture)
-                    else r.result(timeout) for r in refs]
+            # ray.get's timeout is ONE overall deadline, not per ref.
+            deadline = None if timeout is None \
+                else time.monotonic() + timeout
+            out = []
+            for r in refs:
+                if isinstance(r, ProcessFuture):
+                    remaining = None if deadline is None \
+                        else max(0.0, deadline - time.monotonic())
+                    out.append(r.result(remaining))
+                else:
+                    out.append(_resolve_arg(r))
+            return out
         if isinstance(refs, ProcessFuture):
             return refs.result(timeout)
         return _resolve_arg(refs)

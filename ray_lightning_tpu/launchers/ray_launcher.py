@@ -141,6 +141,7 @@ class RayLauncher:
             # Parity: ``ray_launcher.py:41-42`` — connect on first use.
             self._ray.init()
         self._workers: List[Any] = []
+        self._tpu_request: Optional[int] = None
         self._coordinator_address: Optional[str] = None
         self.queue: Any = None
         self._master_addr: Optional[str] = None
@@ -190,10 +191,14 @@ class RayLauncher:
             f"{self._master_addr}:{self._master_port}")
 
         self._setup_env_vars()
-        if strategy.use_tpu:
-            self._share_tpu_visibility()
         node_ips = self._ray.get(
             [w.get_node_ip.remote() for w in self._workers])
+        if strategy.use_tpu:
+            if strategy.allow_colocated_workers:
+                self._share_tpu_visibility()
+            else:
+                self._check_one_actor_per_host(node_ips)
+                self._set_own_chip_visibility()
         strategy.set_global_to_local(self.get_local_ranks(node_ips))
 
         self.queue = None
@@ -222,7 +227,7 @@ class RayLauncher:
         executable_cls = get_executable_cls() or ExecutorBase
         resources = dict(strategy.additional_resources_per_worker)
         if strategy.use_tpu and strategy.num_chips_per_worker:
-            resources.setdefault("TPU", strategy.num_chips_per_worker)
+            resources.setdefault("TPU", self._tpu_request_per_worker())
         remote_cls = self._ray.remote(executable_cls)
         return remote_cls.options(
             num_cpus=strategy.num_cpus_per_worker,
@@ -230,6 +235,53 @@ class RayLauncher:
             resources=resources or None,
             runtime_env=strategy.worker_runtime_env or None,
         ).remote()
+
+    def _tpu_request_per_worker(self):
+        """The Ray ``TPU`` resource each executor actor requests.
+
+        libtpu is single-owner per chip, so the one-actor-per-host layout
+        the module docstring promises must be *scheduled*, not hoped for:
+        requesting a host's full chip count makes Ray's bin-packing place
+        exactly one actor per TPU host (ADVICE round 1 — the reference's
+        fractional-GPU packing, ``ray_launcher.py:105-115``, is the wrong
+        model for TPU). An explicit ``resources_per_worker={"TPU": n}``
+        still wins for unusual layouts.
+        """
+        strategy = self._strategy
+        if strategy._explicit_chip_request:
+            return strategy.num_chips_per_worker
+        if self._tpu_request is None:  # one node-table RPC per launch, not N
+            from ray_lightning_tpu.parallel.topology import (
+                chips_per_host_from_ray, topology_from_env)
+            chips = chips_per_host_from_ray(self._ray)
+            if chips is None:
+                topo = topology_from_env()
+                if topo is not None:
+                    chips = topo.chips_per_host
+            self._tpu_request = max(chips or 0, strategy.num_chips_per_worker)
+        return self._tpu_request
+
+    def _check_one_actor_per_host(self, node_ips: List[str]) -> None:
+        """At most one TPU executor per node, or fail before rendezvous.
+
+        Co-located XLA processes with overlapping chip visibility deadlock
+        inside libtpu init — failing here, with names, beats hanging in a
+        collective. ``allow_colocated_workers=True`` opts into the legacy
+        visibility-union behavior (CPU meshes / sub-host debug layouts).
+        """
+        counts: Dict[str, int] = defaultdict(int)
+        for ip in node_ips:
+            counts[ip] += 1
+        crowded = {ip: n for ip, n in counts.items() if n > 1}
+        if crowded:
+            raise RuntimeError(
+                f"Multiple TPU workers landed on the same host(s): "
+                f"{crowded}. Each TPU host must run exactly one XLA "
+                "process owning all its chips (libtpu is single-owner). "
+                "Lower num_workers to the host count, let the launcher "
+                "request full-host TPU resources (drop any explicit "
+                "resources_per_worker={'TPU': ...}), or pass "
+                "allow_colocated_workers=True to accept shared hosts.")
 
     def _setup_env_vars(self) -> None:
         """Broadcast rendezvous + seed env to every actor.
@@ -247,9 +299,26 @@ class RayLauncher:
         ]
         self._ray.get(futures)
 
+    def _set_own_chip_visibility(self) -> None:
+        """Each actor's ``TPU_VISIBLE_CHIPS`` = exactly the chips its host
+        owns — the default, one-actor-per-host layout (already enforced by
+        `_check_one_actor_per_host`), so no union across actors exists."""
+        node_and_chips = self._ray.get(
+            [w.get_node_and_chip_ids.remote() for w in self._workers])
+        futures = []
+        for worker, (_node_ip, chip_ids) in zip(self._workers,
+                                                node_and_chips):
+            if chip_ids:
+                visible = ",".join(str(i) for i in sorted(set(chip_ids)))
+                futures.append(
+                    worker.set_env_var.remote(TPU_VISIBLE_CHIPS_ENV, visible))
+        if futures:
+            self._ray.get(futures)
+
     def _share_tpu_visibility(self) -> None:
         """Per-node union of chip ids → ``TPU_VISIBLE_CHIPS`` on co-located
-        actors, so each XLA process can address every chip its host owns.
+        actors (the ``allow_colocated_workers=True`` path only — overlapping
+        chip ownership deadlocks libtpu, so sharing hosts is opt-in).
 
         Parity: ``_share_cuda_visible_devices`` (``ray_launcher.py:178-220``),
         whose purpose is intra-node P2P; the TPU analog is intra-host chip

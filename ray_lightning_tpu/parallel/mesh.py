@@ -126,4 +126,5 @@ def build_mesh(spec: MeshSpec,
 
 def multi_host_device_order(mesh: Mesh) -> List[int]:
     """Process indices in mesh order — used by the launcher's rank mapping."""
-    return [d.process_index for d in mesh.devices.flat]
+    from ray_lightning_tpu.parallel.topology import multi_host_device_order
+    return multi_host_device_order(mesh)

@@ -1,0 +1,260 @@
+"""TPU slice topology: host/chip layout discovery and rank↔mesh alignment.
+
+The reference maps nodes by comparing actor IP strings
+(``ray_lightning/launchers/ray_launcher.py:131-158``) and brokers GPU
+visibility by unioning ``CUDA_VISIBLE_DEVICES`` per node (``:178-220``) —
+enough for NCCL, where a process owns exactly one CUDA device and peers
+P2P within a node. TPU needs more structure:
+
+- a **slice** has a fixed shape (e.g. v4-32 = 4 hosts × 4 chips, each chip
+  a 2-core "megacore" presented as one XLA device), advertised to every
+  TPU-VM through metadata env vars;
+- **libtpu is single-owner**: exactly one process may drive a chip, so the
+  launcher must schedule ONE actor per host that owns every chip on it —
+  co-located XLA processes with overlapping visibility deadlock at init;
+- the launcher's global rank must equal ``jax.process_index()`` and the
+  mesh's flat device order must group processes contiguously, or per-host
+  batch feeding (``sharding.put_global_batch``) silently feeds the wrong
+  shard of the global batch to a host.
+
+This module owns those three concerns. Detection prefers the TPU-VM
+environment (authoritative on real slices), then Ray node resources, then
+local device files; everything takes an injectable ``env`` / ``ray``
+for the fake-topology tests (the analog of the reference's scripted
+``Node1Actor``/``Node2Actor`` stubs, ``tests/test_ddp.py:80-114``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import math
+import os
+import re
+from typing import Any, List, Mapping, Optional, Tuple
+
+# GCE TPU-VM metadata environment (set by the TPU runtime on every worker).
+ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"
+WORKER_ID_ENV = "TPU_WORKER_ID"
+WORKER_HOSTNAMES_ENV = "TPU_WORKER_HOSTNAMES"
+CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"
+HOST_BOUNDS_ENV = "TPU_HOST_BOUNDS"
+
+# Per-generation physical layout. `count_unit` says what the number in the
+# accelerator type string counts: TensorCores (v2-v4, v5p) or chips
+# (v5e/v6e). Megacore generations fuse a chip's 2 cores into one XLA device.
+_GENERATIONS = {
+    #  gen        cores/chip  chips/host  megacore  count_unit
+    "v2": (2, 4, False, "cores"),
+    "v3": (2, 4, False, "cores"),
+    "v4": (2, 4, True, "cores"),
+    "v5p": (2, 4, True, "cores"),
+    "v5litepod": (1, 8, False, "chips"),
+    "v5e": (1, 8, False, "chips"),
+    "v6e": (1, 8, False, "chips"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUTopology:
+    """Shape of the TPU slice this job runs on.
+
+    ``devices_per_host`` is the number of XLA devices a single-owner
+    process on that host will see — chips under megacore (v4/v5p) or on
+    single-core chips (v5e), cores otherwise (v2/v3).
+    """
+    accelerator_type: str
+    num_hosts: int
+    chips_per_host: int
+    cores_per_chip: int = 1
+    megacore: bool = False
+    worker_id: int = 0
+    worker_hostnames: Tuple[str, ...] = ()
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+    @property
+    def devices_per_host(self) -> int:
+        if self.megacore or self.cores_per_chip == 1:
+            return self.chips_per_host
+        return self.chips_per_host * self.cores_per_chip
+
+    @property
+    def total_devices(self) -> int:
+        return self.num_hosts * self.devices_per_host
+
+    def local_ranks(self) -> List[Tuple[int, int]]:
+        """global rank → (local, node) for the one-process-per-host layout:
+        rank h lives alone on host h. The shape ``RayLauncher.get_local_ranks``
+        must reproduce from actor node IPs on a correctly spread slice."""
+        return [(0, h) for h in range(self.num_hosts)]
+
+
+def parse_accelerator_type(accel_type: str) -> Optional[TPUTopology]:
+    """Topology from a TPU accelerator-type string (``v4-32``,
+    ``v5litepod-16``, ``v3-8``...). Returns None if unparseable."""
+    m = re.fullmatch(r"(v\d+[a-z]*)(?:pod)?-(\d+)", accel_type.strip())
+    if not m:
+        return None
+    gen, count = m.group(1), int(m.group(2))
+    if gen + "pod" in _GENERATIONS:  # "v5litepod-16" splits as v5lite+pod
+        gen = gen + "pod"
+    if gen not in _GENERATIONS:
+        return None
+    cores_per_chip, chips_per_host_max, megacore, unit = _GENERATIONS[gen]
+    chips = count // cores_per_chip if unit == "cores" else count
+    chips = max(chips, 1)
+    chips_per_host = min(chips, chips_per_host_max)
+    num_hosts = max(1, math.ceil(chips / chips_per_host))
+    return TPUTopology(
+        accelerator_type=accel_type,
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        cores_per_chip=cores_per_chip,
+        megacore=megacore)
+
+
+def _parse_bounds(bounds: str) -> Optional[int]:
+    """Product of a ``"2,2,1"``-style bounds triple."""
+    try:
+        parts = [int(p) for p in bounds.split(",") if p.strip()]
+    except ValueError:
+        return None
+    return math.prod(parts) if parts else None
+
+
+def topology_from_env(
+        env: Optional[Mapping[str, str]] = None) -> Optional[TPUTopology]:
+    """Topology from TPU-VM metadata env vars; None when not on a TPU-VM.
+
+    ``TPU_HOST_BOUNDS``/``TPU_CHIPS_PER_HOST_BOUNDS`` are authoritative for
+    the shape when present; the accelerator-type string fills in chip
+    microarchitecture (cores, megacore)."""
+    env = os.environ if env is None else env
+    accel_type = env.get(ACCELERATOR_TYPE_ENV, "")
+    parsed = parse_accelerator_type(accel_type) if accel_type else None
+
+    hosts = _parse_bounds(env.get(HOST_BOUNDS_ENV, ""))
+    chips_per_host = _parse_bounds(env.get(CHIPS_PER_HOST_BOUNDS_ENV, ""))
+    hostnames = tuple(
+        h.strip() for h in env.get(WORKER_HOSTNAMES_ENV, "").split(",")
+        if h.strip())
+    if hosts is None and hostnames:
+        hosts = len(hostnames)
+    if parsed is None and hosts is None and chips_per_host is None:
+        return None
+
+    try:
+        worker_id = int(env.get(WORKER_ID_ENV, "0"))
+    except ValueError:
+        worker_id = 0
+    return TPUTopology(
+        accelerator_type=accel_type,
+        num_hosts=hosts if hosts is not None else
+        (parsed.num_hosts if parsed else 1),
+        chips_per_host=chips_per_host if chips_per_host is not None else
+        (parsed.chips_per_host if parsed else 1),
+        cores_per_chip=parsed.cores_per_chip if parsed else 1,
+        megacore=parsed.megacore if parsed else False,
+        worker_id=worker_id,
+        worker_hostnames=hostnames)
+
+
+def chips_per_host_from_ray(ray_module: Any) -> Optional[int]:
+    """Per-host chip count from Ray's node table: the smallest per-node
+    ``TPU`` resource total among TPU nodes (requesting that many chips per
+    actor makes Ray's bin-packing spread one actor per host — the
+    scheduling-level fix for overlapping chip ownership; see ADVICE on
+    ``_create_worker``). None if Ray exposes no TPU nodes."""
+    nodes_fn = getattr(ray_module, "nodes", None)
+    if nodes_fn is None:
+        return None
+    try:
+        nodes = nodes_fn()
+    except Exception:
+        return None
+    counts = []
+    for node in nodes or []:
+        if not node.get("Alive", True):
+            continue
+        tpu = node.get("Resources", {}).get("TPU")
+        if tpu:
+            counts.append(int(tpu))
+    return min(counts) if counts else None
+
+
+def local_chip_count() -> int:
+    """Chips physically present on this host (``/dev/accel*`` / vfio)."""
+    n = len(glob.glob("/dev/accel[0-9]*"))
+    if n == 0:
+        n = len(glob.glob("/dev/vfio/[0-9]*"))
+    return n
+
+
+def detect_topology(env: Optional[Mapping[str, str]] = None,
+                    ray_module: Any = None) -> TPUTopology:
+    """Best-effort topology: TPU-VM env → Ray node resources → local
+    devices → single-host fallback."""
+    topo = topology_from_env(env)
+    if topo is not None:
+        return topo
+    if ray_module is not None:
+        chips = chips_per_host_from_ray(ray_module)
+        if chips:
+            return TPUTopology(accelerator_type="", num_hosts=1,
+                               chips_per_host=chips)
+    chips = local_chip_count()
+    return TPUTopology(accelerator_type="", num_hosts=1,
+                       chips_per_host=max(chips, 1))
+
+
+def multi_host_device_order(mesh: Any) -> List[int]:
+    """Process index of each device in mesh-flat order."""
+    return [d.process_index for d in mesh.devices.flat]
+
+
+def assert_mesh_process_alignment(mesh: Any,
+                                  global_rank: Optional[int] = None,
+                                  process_index: Optional[int] = None) -> None:
+    """Fail loudly if the launcher's rank model and the mesh disagree.
+
+    Two invariants, both load-bearing for per-host batch feeding
+    (``sharding.put_global_batch`` transfers the index-slices owned by each
+    process, so slice→process assignment must match rank→host assignment):
+
+    1. the mesh's flat device order groups each process's devices into one
+       contiguous run, with first appearances in ascending process order —
+       i.e. ``multi_host_device_order(mesh)`` looks like
+       ``[0,0,..,1,1,..,N-1,..]``;
+    2. this worker's launcher-assigned global rank equals its JAX process
+       index (the launcher passed ``process_id=global_rank`` to
+       ``jax.distributed.initialize``; anything else means the rendezvous
+       handed out different ids).
+
+    Accepts any mesh-like object whose ``devices.flat`` yields objects with
+    ``process_index`` (fake meshes in tests).
+    """
+    order = multi_host_device_order(mesh)
+    seen: List[int] = []
+    for p in order:
+        if seen and p == seen[-1]:
+            continue
+        if p in seen:
+            raise AssertionError(
+                f"Mesh device order interleaves processes: {order}. "
+                "Per-host batch shards would not be contiguous; build the "
+                "mesh with mesh_utils.create_device_mesh / contiguous "
+                "process blocks.")
+        seen.append(p)
+    if seen != sorted(seen):
+        raise AssertionError(
+            f"Mesh first-appearance process order {seen} is not ascending; "
+            "global rank r would not feed host r's devices.")
+    if global_rank is not None and process_index is not None \
+            and global_rank != process_index:
+        raise AssertionError(
+            f"Launcher global rank {global_rank} != jax process_index "
+            f"{process_index}: the coordinator handed out a different "
+            "process id than the launcher assigned. Check that every "
+            "worker passed its launcher rank to worker_setup().")

@@ -39,6 +39,7 @@ class Strategy:
                  resources_per_worker: Optional[Dict] = None,
                  worker_runtime_env: Optional[Dict] = None,
                  use_ray: Optional[bool] = None,
+                 allow_colocated_workers: bool = False,
                  **kwargs: Any):
         """Resource-spec semantics mirror ``ray_ddp.py:85-112``:
         ``resources_per_worker`` entries override the dedicated args —
@@ -55,6 +56,10 @@ class Strategy:
 
         accel = resources_per_worker.pop("TPU",
                                          resources_per_worker.pop("GPU", None))
+        # An explicit TPU/GPU entry pins the Ray resource request; the bare
+        # use_tpu flag leaves it to the launcher, which requests the host's
+        # full chip count so Ray spreads one single-owner actor per host.
+        self._explicit_chip_request = accel is not None
         if accel is not None:
             self.num_chips_per_worker = accel
         elif use_tpu is not None:
@@ -76,6 +81,7 @@ class Strategy:
         self.additional_resources_per_worker = resources_per_worker
         self.init_hook = init_hook
         self.use_ray = use_ray
+        self.allow_colocated_workers = allow_colocated_workers
         self.extra_kwargs = kwargs
 
         self._mesh: Optional[Mesh] = None
@@ -158,6 +164,12 @@ class Strategy:
                     coordinator_address=coordinator_address,
                     num_processes=num_processes,
                     process_id=process_idx)
+            if jax.process_index() != process_idx:
+                raise AssertionError(
+                    f"Launcher assigned global rank {process_idx} but the "
+                    f"coordinator handed out process_index "
+                    f"{jax.process_index()}: rank map and device mesh "
+                    "disagree; per-host batch shards would be misrouted.")
         self.set_world_ranks(process_idx)
 
     # ------------------------------------------------------------------ #
@@ -170,6 +182,14 @@ class Strategy:
     def mesh(self) -> Mesh:
         if self._mesh is None:
             self._mesh = build_mesh(self.mesh_spec(), self._mesh_devices())
+            if jax.process_count() > 1:
+                # Rank-map ↔ mesh alignment: per-host batch feeding relies
+                # on global rank r owning the r-th contiguous device block.
+                from ray_lightning_tpu.parallel.topology import (
+                    assert_mesh_process_alignment)
+                assert_mesh_process_alignment(
+                    self._mesh, global_rank=self._global_rank,
+                    process_index=jax.process_index())
         return self._mesh
 
     def _mesh_devices(self):

@@ -97,10 +97,11 @@ def test_seed_forwarded_to_workers(monkeypatch):
     launcher.teardown_workers()
 
 
-def test_tpu_visibility_union_per_node():
-    """Chip-visibility union parity (``ray_launcher.py:178-220``): actors
-    co-located on a node all see the union of that node's chips; actors on
-    other nodes see only their own."""
+def test_tpu_visibility_union_per_node_opt_in():
+    """Chip-visibility union parity (``ray_launcher.py:178-220``): with
+    ``allow_colocated_workers=True``, actors co-located on a node all see
+    the union of that node's chips; actors on other nodes see only their
+    own."""
 
     class Alternating(RecordingExecutor):
         def node_ip(self):
@@ -110,7 +111,8 @@ def test_tpu_visibility_union_per_node():
             idx = RecordingExecutor.instances.index(self)
             return {0: [0, 1], 1: [2, 3], 2: [0, 1]}[idx]
 
-    strategy = rlt.RayStrategy(num_workers=3, use_tpu=True)
+    strategy = rlt.RayStrategy(num_workers=3, use_tpu=True,
+                               allow_colocated_workers=True)
     launcher, _ = _make_launcher(strategy, Alternating)
     launcher.setup_workers()
     envs = [a.env.get(TPU_VISIBLE_CHIPS_ENV)
@@ -119,6 +121,15 @@ def test_tpu_visibility_union_per_node():
     assert envs[1] == "0,1,2,3"  # node 1 union across both actors
     assert envs[2] == "0,1"      # node 2's own chips only
     launcher.teardown_workers()
+
+
+def test_colocated_tpu_workers_rejected_by_default():
+    """libtpu is single-owner per chip: two TPU executors on one host must
+    fail loudly at setup, not hang at collective init (round-1 ADVICE)."""
+    strategy = rlt.RayStrategy(num_workers=2, use_tpu=True)
+    launcher, _ = _make_launcher(strategy)  # default executor: one node ip
+    with pytest.raises(RuntimeError, match="same host"):
+        launcher.setup_workers()
 
 
 def test_global_to_local_installed_on_strategy():
