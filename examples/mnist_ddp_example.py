@@ -14,7 +14,23 @@ import argparse
 
 from ray_lightning_tpu import RayStrategy, Trainer
 from ray_lightning_tpu.core.callbacks import EpochStatsCallback
+from ray_lightning_tpu.data import MultiprocessDataLoader
 from ray_lightning_tpu.models import LightningMNISTClassifier
+
+
+class MNISTWithLoaderWorkers(LightningMNISTClassifier):
+    """MNIST classifier feeding training through the native shm-ring
+    multiprocess loader: N producer processes assemble batches GIL-free
+    while the device steps — the parity seat of the reference example's
+    torch ``DataLoader(num_workers=N)``."""
+
+    def __init__(self, config=None, num_samples=8192, data_workers=2):
+        super().__init__(config=config, num_samples=num_samples)
+        self.data_workers = data_workers
+
+    def train_dataloader(self):
+        return MultiprocessDataLoader(super().train_dataloader(),
+                                      num_workers=self.data_workers)
 
 
 def main():
@@ -25,12 +41,21 @@ def main():
     parser.add_argument("--max-epochs", type=int, default=3)
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--data-workers", type=int, default=0,
+                        help="Multiprocess data-loader producers (0 = load "
+                             "inline on the training process).")
     parser.add_argument("--smoke-test", action="store_true", default=False)
     args = parser.parse_args()
 
-    model = LightningMNISTClassifier(
-        config={"lr": args.lr, "batch_size": args.batch_size},
-        num_samples=1024 if args.smoke_test else 8192)
+    num_samples = 1024 if args.smoke_test else 8192
+    if args.data_workers > 0:
+        model = MNISTWithLoaderWorkers(
+            config={"lr": args.lr, "batch_size": args.batch_size},
+            num_samples=num_samples, data_workers=args.data_workers)
+    else:
+        model = LightningMNISTClassifier(
+            config={"lr": args.lr, "batch_size": args.batch_size},
+            num_samples=num_samples)
     trainer = Trainer(
         strategy=RayStrategy(num_workers=args.num_workers,
                              use_tpu=args.use_tpu),

@@ -36,7 +36,34 @@ class Callback:
     def on_validation_end(self, trainer, pl_module) -> None: ...
     def on_validation_epoch_start(self, trainer, pl_module) -> None: ...
     def on_validation_epoch_end(self, trainer, pl_module) -> None: ...
+    def on_validation_batch_start(self, trainer, pl_module, batch,
+                                  batch_idx: int,
+                                  dataloader_idx: int = 0) -> None: ...
+    def on_validation_batch_end(self, trainer, pl_module, outputs, batch,
+                                batch_idx: int,
+                                dataloader_idx: int = 0) -> None: ...
+    def on_test_start(self, trainer, pl_module) -> None: ...
+    def on_test_end(self, trainer, pl_module) -> None: ...
+    def on_test_epoch_start(self, trainer, pl_module) -> None: ...
     def on_test_epoch_end(self, trainer, pl_module) -> None: ...
+    def on_test_batch_start(self, trainer, pl_module, batch,
+                            batch_idx: int,
+                            dataloader_idx: int = 0) -> None: ...
+    def on_test_batch_end(self, trainer, pl_module, outputs, batch,
+                          batch_idx: int,
+                          dataloader_idx: int = 0) -> None: ...
+    def on_before_optimizer_step(self, trainer, pl_module,
+                                 optimizer) -> None:
+        """Fired once per training batch, before the compiled step.
+
+        TPU-native semantic shift vs PTL: grads, update, and apply are
+        fused into ONE XLA program (the whole point — psum fuses into
+        backprop), so there is no host point "after backward, before
+        step". This hook is the per-batch seat for LR scheduling /
+        optimizer introspection; per-gradient inspection belongs inside
+        ``training_step`` (jnp ops) instead.
+        """
+        ...
     def on_save_checkpoint(self, trainer, pl_module,
                            checkpoint: Dict[str, Any]) -> None: ...
     def on_load_checkpoint(self, trainer, pl_module,

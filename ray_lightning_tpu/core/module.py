@@ -146,6 +146,15 @@ class TpuModule:
     def on_train_epoch_end(self) -> None: ...
     def on_validation_epoch_start(self) -> None: ...
     def on_validation_epoch_end(self) -> None: ...
+    def on_train_batch_start(self, batch, batch_idx: int) -> None: ...
+    def on_train_batch_end(self, outputs, batch, batch_idx: int) -> None: ...
+    def on_validation_batch_start(self, batch, batch_idx: int) -> None: ...
+    def on_validation_batch_end(self, outputs, batch,
+                                batch_idx: int) -> None: ...
+    def on_before_optimizer_step(self, optimizer) -> None:
+        """Per training batch, before the fused compiled step (see
+        ``Callback.on_before_optimizer_step`` for the TPU semantics)."""
+        ...
 
     # checkpointable custom state (parity: BoringModel's
     # on_save_checkpoint/on_load_checkpoint, tests/utils.py:28-96)

@@ -256,6 +256,7 @@ class Trainer:
         model = module.configure_model()
         self._model = model
         tx = self._optimizer()
+        self._tx = tx
         seed = self.seed if self.seed is not None else 0
         root_rng = jax.random.PRNGKey(seed)
         init_rng, state_rng = jax.random.split(root_rng)
@@ -360,13 +361,15 @@ class Trainer:
         for cb in self.callbacks:
             cb.on_fit_start(self, module)
 
-        # sanity validation (PTL parity; Tune callbacks skip this phase)
+        # sanity validation: PTL fires the full validation hook sequence
+        # here too, with trainer.sanity_checking=True so callbacks that
+        # must skip it (e.g. Tune reports) can gate on the flag
         if val_loader is not None and self.num_sanity_val_steps > 0:
             self.sanity_checking = True
             for cb in self.callbacks:
                 cb.on_sanity_check_start(self, module)
-            self._eval_loop(val_loader, self._val_step,
-                            self.num_sanity_val_steps)
+            self._run_validation(val_loader, module,
+                                 limit=self.num_sanity_val_steps)
             for cb in self.callbacks:
                 cb.on_sanity_check_end(self, module)
             self.sanity_checking = False
@@ -390,13 +393,18 @@ class Trainer:
             t0 = time.perf_counter()
             for batch_idx, batch in enumerate(
                     self._prefetch(train_loader, n_batches)):
+                module.on_train_batch_start(batch, batch_idx)
                 for cb in self.callbacks:
                     cb.on_train_batch_start(self, module, batch, batch_idx)
+                module.on_before_optimizer_step(self._tx)
+                for cb in self.callbacks:
+                    cb.on_before_optimizer_step(self, module, self._tx)
                 state, logs = self._train_step(state, batch)
                 self.train_state = state
                 self.global_step += 1
                 epoch_logs.append(logs)
                 self._last_logs = logs
+                module.on_train_batch_end(logs, batch, batch_idx)
                 for cb in self.callbacks:
                     cb.on_train_batch_end(self, module, logs, batch,
                                           batch_idx)
@@ -440,13 +448,15 @@ class Trainer:
 
         return self._collect_rank_zero_results()
 
-    def _run_validation(self, val_loader, module) -> None:
+    def _run_validation(self, val_loader, module, limit=None) -> None:
         module.on_validation_epoch_start()
         for cb in self.callbacks:
             cb.on_validation_start(self, module)
             cb.on_validation_epoch_start(self, module)
-        n = self._resolve_limit(val_loader, self.limit_val_batches)
-        agg = self._eval_loop(val_loader, self._val_step, n)
+        n = self._resolve_limit(
+            val_loader, self.limit_val_batches if limit is None else limit)
+        agg = self._eval_loop(val_loader, self._val_step, n,
+                              module=module, mode="validation")
         self.callback_metrics.update(agg)
         module.on_validation_epoch_end()
         for cb in self.callbacks:
@@ -455,8 +465,12 @@ class Trainer:
         if hasattr(self._launcher, "drain_queue"):
             self._launcher.drain_queue()
 
-    def _eval_loop(self, loader, step_fn,
-                   n_batches: int) -> Dict[str, Any]:
+    def _eval_loop(self, loader, step_fn, n_batches: int,
+                   module=None, mode: Optional[str] = None
+                   ) -> Dict[str, Any]:
+        """``mode`` ("validation" | "test") enables per-batch hooks (the
+        sanity pass uses "validation" too, PTL-style, with
+        ``trainer.sanity_checking`` set for callbacks that must skip it)."""
         logs_list: List[Dict[str, Any]] = []
         # fold the training progress in so successive validation epochs see
         # fresh randomness (round-1 review: a fixed key reused identical
@@ -465,9 +479,21 @@ class Trainer:
             jax.random.PRNGKey(self.seed if self.seed is not None else 0),
             self.global_step)
         for batch_idx, batch in enumerate(self._prefetch(loader, n_batches)):
+            if mode is not None:
+                getattr(module, f"on_{mode}_batch_start",
+                        lambda *a: None)(batch, batch_idx)
+                for cb in self.callbacks:
+                    getattr(cb, f"on_{mode}_batch_start")(
+                        self, module, batch, batch_idx)
             logs = step_fn(self.train_state, batch,
                            jax.random.fold_in(rng, batch_idx))
             logs_list.append(logs)
+            if mode is not None:
+                getattr(module, f"on_{mode}_batch_end",
+                        lambda *a: None)(logs, batch, batch_idx)
+                for cb in self.callbacks:
+                    getattr(cb, f"on_{mode}_batch_end")(
+                        self, module, logs, batch, batch_idx)
         return self._aggregate_epoch_logs(logs_list)
 
     def _aggregate_epoch_logs(self, logs_list: List[Dict[str, Any]],
@@ -557,11 +583,27 @@ class Trainer:
                  self.limit_test_batches)
         step = self._val_step if stage == "validate" else self._test_step
         n = self._resolve_limit(loader, limit)
-        agg = self._eval_loop(loader, step, n)
+        mode = "validation" if stage == "validate" else "test"
+        if stage == "test":
+            for cb in self.callbacks:
+                cb.on_test_start(self, module)
+                cb.on_test_epoch_start(self, module)
+        else:
+            module.on_validation_epoch_start()
+            for cb in self.callbacks:
+                cb.on_validation_start(self, module)
+                cb.on_validation_epoch_start(self, module)
+        agg = self._eval_loop(loader, step, n, module=module, mode=mode)
         self.callback_metrics.update(agg)
-        for cb in self.callbacks:
-            if stage == "test":
+        if stage == "test":
+            for cb in self.callbacks:
                 cb.on_test_epoch_end(self, module)
+                cb.on_test_end(self, module)
+        else:
+            module.on_validation_epoch_end()
+            for cb in self.callbacks:
+                cb.on_validation_epoch_end(self, module)
+                cb.on_validation_end(self, module)
         return WorkerOutput(
             best_model_path=None,
             state_stream=None,

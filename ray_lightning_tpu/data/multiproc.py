@@ -21,10 +21,21 @@ from __future__ import annotations
 import multiprocessing as mp
 import os
 import pickle
+import sys
 import uuid
 from typing import Any, Iterator, Optional
 
 from ray_lightning_tpu._native import ShmRing, native_available
+
+
+def default_mp_context() -> str:
+    """``spawn`` when jax is imported — forking a process holding live XLA
+    runtime threads can deadlock the child (CPython warns, JAX documents
+    it). Since the package itself imports jax, every in-package user gets
+    spawn; the ``fork`` branch only serves code that imported this module
+    standalone. Pass ``mp_context="fork"`` explicitly to trade that safety
+    for copy-on-write dataset inheritance."""
+    return "spawn" if "jax" in sys.modules else "fork"
 
 
 def _worker_batches(loader, worker_id: int, num_workers: int):
@@ -75,18 +86,21 @@ class MultiprocessDataLoader:
     """
 
     def __init__(self, loader: Any, num_workers: int = 2,
-                 ring_capacity: int = 64 << 20, mp_context: str = "fork"):
-        """``mp_context``: ``"fork"`` (default — dataset inherited
-        copy-on-write, but forking a process that already holds live
-        JAX/XLA runtime threads is only safe while the child touches
-        nothing but the ring and the loader) or ``"spawn"`` (fully safe
-        with an initialized JAX runtime; the loader must be picklable)."""
+                 ring_capacity: int = 64 << 20,
+                 mp_context: Optional[str] = None):
+        """``mp_context``: ``None`` (default) picks ``"spawn"`` whenever
+        jax is imported — forking a process holding live XLA runtime
+        threads can deadlock the child — and ``"fork"`` otherwise
+        (dataset inherited copy-on-write, nothing re-pickled). Pass
+        explicitly to override: ``"spawn"`` requires a picklable loader;
+        ``"fork"`` with live JAX is only safe while the child touches
+        nothing but the ring and the loader."""
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         self.loader = loader
         self.num_workers = num_workers
         self.ring_capacity = ring_capacity
-        self.mp_context = mp_context
+        self.mp_context = mp_context or default_mp_context()
         self.native = native_available()
 
     def set_epoch(self, epoch: int) -> None:

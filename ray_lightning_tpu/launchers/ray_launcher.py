@@ -173,6 +173,8 @@ class RayLauncher:
         Parity: ``ray_launcher.py:71-103``.
         """
         strategy = self._strategy
+        if strategy.use_tpu and not strategy.allow_colocated_workers:
+            self._check_enough_tpu_hosts()
         self._workers = [
             self._create_worker(rank) for rank in range(strategy.num_workers)
         ]
@@ -260,6 +262,31 @@ class RayLauncher:
                     chips = topo.chips_per_host
             self._tpu_request = max(chips or 0, strategy.num_chips_per_worker)
         return self._tpu_request
+
+    def _check_enough_tpu_hosts(self) -> None:
+        """Fail before actor creation when the cluster cannot host one
+        full-host actor per worker: an unschedulable actor would pend
+        forever inside ``ray.get`` with no error — the hang-instead-of-fail
+        class this launcher is designed to eliminate. Skipped when the
+        backend exposes no node table (fakes, older Ray)."""
+        nodes_fn = getattr(self._ray, "nodes", None)
+        if nodes_fn is None:
+            return
+        try:
+            nodes = nodes_fn() or []
+        except Exception:
+            return
+        tpu_hosts = sum(
+            1 for n in nodes
+            if n.get("Alive", True) and n.get("Resources", {}).get("TPU"))
+        if tpu_hosts and self._strategy.num_workers > tpu_hosts:
+            raise RuntimeError(
+                f"num_workers={self._strategy.num_workers} but the Ray "
+                f"cluster has only {tpu_hosts} TPU host(s); each worker "
+                "needs a whole host (libtpu is single-owner per chip), so "
+                "the extra actors would pend forever. Lower num_workers, "
+                "add TPU hosts, or pass allow_colocated_workers=True to "
+                "share hosts.")
 
     def _check_one_actor_per_host(self, node_ips: List[str]) -> None:
         """At most one TPU executor per node, or fail before rendezvous.

@@ -1,37 +1,65 @@
-"""A synchronous, in-process ray-compatible fake.
+"""In-process ray-compatible fakes: synchronous and threaded-concurrent.
 
 Implements the exact subset of the Ray API the launcher consumes —
 ``init/is_initialized/remote/put/get/wait/kill`` plus the actor
-``.options(...).remote()`` / ``method.remote(...)`` protocol — with
+``.options(...).remote()`` / ``method.remote(...)`` protocol — in two
+flavors:
 
-- **synchronous execution**: remote calls run immediately in-process and
-  return pre-resolved :class:`FakeObjectRef`\\ s;
-- **a real serialization boundary**: ``put`` round-trips through pickle, so
-  anything unpicklable (actor handles, jitted functions, device arrays)
-  fails in tests exactly where it would fail on a cluster — the pitfall the
-  reference documents at ``ray_launcher.py:274-288``;
-- **top-level ObjectRef resolution** in task args, matching Ray semantics.
+- :class:`FakeRay` — **synchronous**: remote calls run immediately
+  in-process and return pre-resolved refs. Fast, deterministic; the seam
+  most launcher unit tests use.
+- :class:`ThreadedFakeRay` — **concurrent**: each actor owns a
+  single-thread executor (Ray's actor model: one message at a time per
+  actor, actors concurrent with each other); ``method.remote`` returns a
+  future-backed ref, ``ray.wait`` genuinely polls completion, and every
+  task's args cross a real pickle boundary (round-1 verdict: the sync
+  fake's ``execute.remote`` args never crossed serialization, so the
+  per-dispatch payload — trainer ref, rank map, queue — was untested).
+
+Both enforce the serialization-boundary rule the reference documents at
+``ray_launcher.py:274-288``: ``put`` (and, in the threaded fake, task
+args) round-trip through pickle, so anything unpicklable (actor handles,
+jitted functions, device arrays) fails in tests exactly where it would
+fail on a cluster. :class:`FakeQueueHandle` pickles *by reference* the way
+a Ray queue's actor handle does, so queues survive the boundary while
+still funneling to one driver-side queue.
 
 This is the test seam the reference gets from local Ray clusters
 (``tests/test_ddp.py:20-61``); combined with fake executor classes injected
 via :func:`~ray_lightning_tpu.launchers.utils.set_executable_cls` it covers
-rank mapping, env brokering, and the full launch→collect→recover pipeline
-without Ray installed.
+rank mapping, env brokering, concurrent dispatch, and the full
+launch→collect→recover pipeline without Ray installed.
 """
 from __future__ import annotations
 
+import itertools
 import pickle
+import queue as _queue
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class FakeObjectRef:
-    """Pre-resolved stand-in for ``ray.ObjectRef``."""
+    """Stand-in for ``ray.ObjectRef``: pre-resolved value or live future."""
     _is_fake_object_ref = True
 
-    def __init__(self, value: Any):
-        self.value = value
+    def __init__(self, value: Any = None, future: Optional[Future] = None):
+        self._value = value
+        self._future = future
+
+    @property
+    def value(self) -> Any:
+        if self._future is not None:
+            return self._future.result()
+        return self._value
+
+    def done(self) -> bool:
+        return self._future is None or self._future.done()
 
     def __repr__(self) -> str:
+        if self._future is not None and not self._future.done():
+            return "FakeObjectRef(<pending>)"
         return f"FakeObjectRef({type(self.value).__name__})"
 
 
@@ -45,52 +73,107 @@ class FakeActorMethod:
         self._name = name
 
     def remote(self, *args: Any, **kwargs: Any) -> FakeObjectRef:
-        if self._handle._killed:
+        handle = self._handle
+        if handle._killed:
             raise RuntimeError("Actor was killed")
+        backend = handle._backend
         args = tuple(_resolve(a) for a in args)
         kwargs = {k: _resolve(v) for k, v in kwargs.items()}
-        method = getattr(self._handle._instance, self._name)
+        if backend is not None and backend.serialize_task_args:
+            args, kwargs = pickle.loads(pickle.dumps((args, kwargs)))
+        method = getattr(handle._instance, self._name)
+        if handle._pool is not None:
+            return FakeObjectRef(future=handle._pool.submit(
+                method, *args, **kwargs))
         return FakeObjectRef(method(*args, **kwargs))
 
 
 class FakeActorHandle:
-    def __init__(self, instance: Any, options: Dict[str, Any]):
+    def __init__(self, instance: Any, options: Dict[str, Any],
+                 backend: Optional["FakeRay"] = None,
+                 concurrent: bool = False):
         self._instance = instance
         self._options = options
+        self._backend = backend
         self._killed = False
+        # Ray's actor model: one message processed at a time per actor,
+        # actors concurrent with each other → one thread per actor.
+        self._pool = ThreadPoolExecutor(max_workers=1) if concurrent else None
 
     def __getattr__(self, name: str) -> FakeActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
         return FakeActorMethod(self, name)
 
+    def _shutdown_pool(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=False)
+
 
 class FakeRemoteClass:
-    def __init__(self, cls: type, registry: List[FakeActorHandle]):
+    def __init__(self, cls: type, backend: "FakeRay"):
         self._cls = cls
-        self._registry = registry
+        self._backend = backend
         self._options: Dict[str, Any] = {}
 
     def options(self, **options: Any) -> "FakeRemoteClass":
-        out = FakeRemoteClass(self._cls, self._registry)
+        out = FakeRemoteClass(self._cls, self._backend)
         out._options = options
         return out
 
     def remote(self, *args: Any, **kwargs: Any) -> FakeActorHandle:
+        backend = self._backend
         handle = FakeActorHandle(self._cls(*args, **kwargs),
-                                 dict(self._options))
-        self._registry.append(handle)
+                                 dict(self._options), backend=backend,
+                                 concurrent=backend.concurrent)
+        backend.created_actors.append(handle)
         return handle
+
+
+class FakeQueueHandle:
+    """A queue that pickles *by reference* (like a Ray queue actor handle):
+    every unpickled copy funnels to the same in-process queue."""
+
+    _registry: Dict[int, _queue.Queue] = {}
+    _ids = itertools.count()
+
+    def __init__(self, _id: Optional[int] = None):
+        if _id is None:
+            _id = next(FakeQueueHandle._ids)
+            FakeQueueHandle._registry[_id] = _queue.Queue()
+        self._id = _id
+
+    def __reduce__(self):
+        return (FakeQueueHandle, (self._id,))
+
+    @property
+    def _q(self) -> _queue.Queue:
+        return FakeQueueHandle._registry[self._id]
+
+    def put(self, item: Any) -> None:
+        self._q.put(item)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        return self._q.get(block=block, timeout=timeout)
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def shutdown(self) -> None:
+        FakeQueueHandle._registry.pop(self._id, None)
 
 
 class FakeRay:
     """Drop-in module-like object for ``RayLauncher(ray_module=...)``."""
 
     ObjectRef = FakeObjectRef
+    concurrent = False
 
-    def __init__(self, serialize_puts: bool = True):
+    def __init__(self, serialize_puts: bool = True,
+                 serialize_task_args: bool = False):
         self._initialized = False
         self.serialize_puts = serialize_puts
+        self.serialize_task_args = serialize_task_args
         self.created_actors: List[FakeActorHandle] = []
         self.killed_actors: List[FakeActorHandle] = []
 
@@ -118,16 +201,45 @@ class FakeRay:
     def wait(self, refs: List[Any], num_returns: int = 1,
              timeout: Optional[float] = None
              ) -> Tuple[List[Any], List[Any]]:
-        # Synchronous backend: everything is already done.
-        return list(refs), []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            done = [r for r in refs
+                    if not isinstance(r, FakeObjectRef) or r.done()]
+            if len(done) >= num_returns or (
+                    deadline is not None
+                    and time.monotonic() >= deadline):
+                # ray.wait caps the ready set at num_returns even when more
+                # have finished; the rest stay in the unfinished list.
+                ready = done[:num_returns]
+                return ready, [r for r in refs if r not in ready]
+            time.sleep(0.002)
 
     # -- actors -------------------------------------------------------- #
     def remote(self, cls: type) -> FakeRemoteClass:
-        return FakeRemoteClass(cls, self.created_actors)
+        return FakeRemoteClass(cls, self)
 
     def kill(self, actor: FakeActorHandle, no_restart: bool = False) -> None:
         actor._killed = True
+        actor._shutdown_pool()
         self.killed_actors.append(actor)
+
+
+class ThreadedFakeRay(FakeRay):
+    """Concurrent fake: actors run in their own threads, task args cross
+    pickle, ``wait`` genuinely polls. The closest no-Ray approximation of
+    a local cluster's scheduling semantics."""
+
+    concurrent = True
+
+    def __init__(self, serialize_puts: bool = True,
+                 serialize_task_args: bool = True):
+        super().__init__(serialize_puts=serialize_puts,
+                         serialize_task_args=serialize_task_args)
+
+    def make_queue(self) -> FakeQueueHandle:
+        # The launcher prefers a backend-supplied queue; this one survives
+        # the task-arg pickle boundary by reference, like Ray's.
+        return FakeQueueHandle()
 
 
 class RecordingExecutor:

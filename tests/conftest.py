@@ -8,6 +8,20 @@ runs without TPU hardware. Must be configured before jax imports.
 """
 import os
 
+# Snapshot the pre-test env first: the opt-in real-TPU suite
+# (tests/test_tpu.py) reconstructs it to reach the chip from subprocesses.
+# Stored in os.environ sentinels (not module globals) because this file is
+# imported twice — as pytest's `conftest` and as `tests.conftest` — and the
+# second import must not re-capture the already-mutated values.
+_UNSET = "<TL-UNSET>"
+for _k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS"):
+    os.environ.setdefault("TL_TEST_ORIG_" + _k, os.environ.get(_k, _UNSET))
+ORIGINAL_TPU_ENV = {
+    k: (None if os.environ["TL_TEST_ORIG_" + k] == _UNSET
+        else os.environ["TL_TEST_ORIG_" + k])
+    for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+}
+
 # Disable the axon TPU plugin + force an 8-device virtual CPU platform.
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"

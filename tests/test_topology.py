@@ -208,3 +208,15 @@ def test_alignment_rank_mismatch_rejected():
 def test_alignment_rank_match_ok():
     topo.assert_mesh_process_alignment(
         _FakeMesh([0, 0, 1, 1]), global_rank=1, process_index=1)
+
+
+def test_too_many_workers_for_tpu_hosts_fails_before_actor_creation():
+    """An unschedulable full-host actor would pend forever in ray.get;
+    the launcher must raise up front from the node table instead."""
+    fake = FourHostTPURay()
+    launcher_utils.set_executable_cls(HostExecutor)
+    strategy = rlt.RayStrategy(num_workers=5, use_tpu=True)
+    launcher = RayLauncher(strategy, ray_module=fake)
+    with pytest.raises(RuntimeError, match="TPU host"):
+        launcher.setup_workers()
+    assert fake.created_actors == []
