@@ -139,7 +139,7 @@ def _build_bert_step(strategy, batch_size: int, seq_len: int):
                                                _synthetic_classification_tokens)
 
     cfg = bert_config("base", vocab_size=30522, max_seq_len=seq_len,
-                      dtype=jnp.bfloat16)
+                      dtype=jnp.bfloat16, remat=True)
     model = BertClassifier(cfg, num_classes=2)
     tx = optax.adamw(5e-5, weight_decay=0.01)
     x, y = _synthetic_classification_tokens(batch_size, seq_len,
@@ -365,15 +365,20 @@ def main() -> None:
     }
 
     try:
-        bert = bench_model(_build_bert_step, samples_per_step=32,
-                           analytic_tokens=32 * 128,
-                           batch_size=32, seq_len=128)
+        # batch 128 + remat measured fastest on v5e (sweep: 32→1027 sps,
+        # 64→1340, 96 no-remat→1329, 128 remat→1629, 160/192/256 remat
+        # regress). MFU counts only required model FLOPs (6NT), not the
+        # remat recompute — the standard MFU convention.
+        bert_batch = 128
+        bert = bench_model(_build_bert_step, samples_per_step=bert_batch,
+                           analytic_tokens=bert_batch * 128,
+                           batch_size=bert_batch, seq_len=128)
         extras["bert_base"] = {
             "samples_per_sec_per_chip": round(
                 bert["samples_per_sec_per_chip"], 2),
             "mfu": round(bert["mfu"], 4) if bert["mfu"] else None,
             "flops_per_step": bert["flops_per_step"],
-            "batch": 32, "seq_len": 128,
+            "batch": bert_batch, "seq_len": 128,
         }
     except Exception as exc:  # secondary benches degrade to a diagnostic
         extras["bert_base"] = {"error": f"{type(exc).__name__}: {exc}"}

@@ -448,7 +448,7 @@ class Trainer:
 
         return self._collect_rank_zero_results()
 
-    def _run_validation(self, val_loader, module, limit=None) -> None:
+    def _run_validation(self, val_loader, module, limit=None):
         module.on_validation_epoch_start()
         for cb in self.callbacks:
             cb.on_validation_start(self, module)
@@ -457,13 +457,17 @@ class Trainer:
             val_loader, self.limit_val_batches if limit is None else limit)
         agg = self._eval_loop(val_loader, self._val_step, n,
                               module=module, mode="validation")
-        self.callback_metrics.update(agg)
+        if not self.sanity_checking:
+            # PTL discards sanity metrics: 2 untrained-weight batches must
+            # never drive checkpoint monitors or reported values
+            self.callback_metrics.update(agg)
         module.on_validation_epoch_end()
         for cb in self.callbacks:
             cb.on_validation_epoch_end(self, module)
             cb.on_validation_end(self, module)
         if hasattr(self._launcher, "drain_queue"):
             self._launcher.drain_queue()
+        return agg
 
     def _eval_loop(self, loader, step_fn, n_batches: int,
                    module=None, mode: Optional[str] = None
@@ -579,31 +583,19 @@ class Trainer:
                        "test_dataloader")
         loader = self._prepare_eval(module, datamodule, ckpt_path, stage,
                                     loader_name)
-        limit = (self.limit_val_batches if stage == "validate" else
-                 self.limit_test_batches)
-        step = self._val_step if stage == "validate" else self._test_step
-        n = self._resolve_limit(loader, limit)
-        mode = "validation" if stage == "validate" else "test"
-        if stage == "test":
+        if stage == "validate":
+            agg = self._run_validation(loader, module)
+        else:
+            n = self._resolve_limit(loader, self.limit_test_batches)
             for cb in self.callbacks:
                 cb.on_test_start(self, module)
                 cb.on_test_epoch_start(self, module)
-        else:
-            module.on_validation_epoch_start()
-            for cb in self.callbacks:
-                cb.on_validation_start(self, module)
-                cb.on_validation_epoch_start(self, module)
-        agg = self._eval_loop(loader, step, n, module=module, mode=mode)
-        self.callback_metrics.update(agg)
-        if stage == "test":
+            agg = self._eval_loop(loader, self._test_step, n,
+                                  module=module, mode="test")
+            self.callback_metrics.update(agg)
             for cb in self.callbacks:
                 cb.on_test_epoch_end(self, module)
                 cb.on_test_end(self, module)
-        else:
-            module.on_validation_epoch_end()
-            for cb in self.callbacks:
-                cb.on_validation_epoch_end(self, module)
-                cb.on_validation_end(self, module)
         return WorkerOutput(
             best_model_path=None,
             state_stream=None,

@@ -276,10 +276,12 @@ class RayLauncher:
             nodes = nodes_fn() or []
         except Exception:
             return
+        if not nodes:
+            return  # degenerate/partial node table — nothing to conclude
         tpu_hosts = sum(
             1 for n in nodes
             if n.get("Alive", True) and n.get("Resources", {}).get("TPU"))
-        if tpu_hosts and self._strategy.num_workers > tpu_hosts:
+        if self._strategy.num_workers > tpu_hosts:
             raise RuntimeError(
                 f"num_workers={self._strategy.num_workers} but the Ray "
                 f"cluster has only {tpu_hosts} TPU host(s); each worker "

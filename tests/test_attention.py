@@ -107,3 +107,48 @@ def test_gpt_with_flash_attention(tmp_path):
                       default_root_dir=str(tmp_path))
     trainer.fit(model)
     assert trainer.global_step == 4
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T,S,block", [(64, 64, 32), (96, 48, 64)])
+def test_pallas_flash_grads_interpret(causal, T, S, block):
+    """The pallas backward kernels (custom_vjp) match XLA's autodiff of
+    the reference — round-2 find: the bare kernel had no JVP rule, so
+    attention_impl='flash' crashed every TPU training step."""
+    q, k, v = _qkv(T=T, S=S)
+    do = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+
+    def f(q, k, v):
+        return jnp.sum(pallas_flash_attention(
+            q, k, v, causal=causal, block_q=block, block_k=block,
+            interpret=True) * do)
+
+    def r(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=causal) * do)
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"d{name}")
+
+
+def test_pallas_flash_grads_bf16_interpret():
+    q, k, v = _qkv(T=64, dtype=jnp.bfloat16)
+    do = jax.random.normal(jax.random.PRNGKey(8), q.shape, jnp.float32)
+
+    def f(q, k, v):
+        return jnp.sum(pallas_flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=32,
+            interpret=True).astype(jnp.float32) * do)
+
+    def r(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, causal=True).astype(jnp.float32) * do)
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(w, np.float32),
+                                   rtol=5e-2, atol=5e-2)

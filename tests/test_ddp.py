@@ -286,3 +286,18 @@ def test_module_batch_hooks_fire(tmp_root):
     assert ("train_start", 0) in seen and ("train_end", 1) in seen
     assert ("opt", True) in seen
     assert ("val_start", 0) in seen and ("val_end", 0) in seen
+
+
+def test_sanity_metrics_discarded(tmp_root):
+    """PTL parity: the sanity pass must not leave its untrained-weight
+    metrics in callback_metrics (they could drive checkpoint monitors)."""
+    model = XORModel()
+    dm = XORDataModule()
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=1, check_val_every_n_epoch=10,
+                      num_sanity_val_steps=1, enable_checkpointing=False,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(model, datamodule=dm)
+    # validation never ran (every 10 epochs), sanity did — its metrics
+    # must not appear
+    assert not any(k.startswith("val") for k in trainer.callback_metrics)

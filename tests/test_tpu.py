@@ -128,8 +128,21 @@ def test_flash_attention_kernel_on_chip():
         got = flash_attention(q, k, v, causal=True)
         want = dot_product_attention(q, k, v, causal=True)
         err = float(jnp.max(jnp.abs(got - want)))
+
+        # backward: the pallas dq/dk/dv kernels vs XLA autodiff
+        do = jax.random.normal(jax.random.fold_in(rng, 9), shape)
+        f = lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True) * do)
+        r = lambda q, k, v: jnp.sum(
+            dot_product_attention(q, k, v, causal=True) * do)
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        gerr = max(float(jnp.max(jnp.abs(a - b)))
+                   for a, b in zip(gf, gr))
         print(json.dumps({
-            "platform": jax.devices()[0].platform, "max_err": err}))
+            "platform": jax.devices()[0].platform, "max_err": err,
+            "max_grad_err": gerr}))
     """)
     assert out["platform"] == "tpu"
     assert out["max_err"] < 2e-2
+    assert out["max_grad_err"] < 5e-2
