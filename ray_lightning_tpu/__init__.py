@@ -16,7 +16,8 @@ from ray_lightning_tpu.strategies import (RayStrategy, DataParallelStrategy,
                                           RayShardedStrategy, ZeroOneStrategy,
                                           HorovodRayStrategy,
                                           AllReduceStrategy, FSDPStrategy,
-                                          MeshStrategy)
+                                          MeshStrategy,
+                                          SequenceParallelStrategy)
 from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
                                     Callback, ModelCheckpoint,
                                     EpochStatsCallback, seed_everything)
@@ -27,7 +28,8 @@ __version__ = "0.1.0"
 __all__ = [
     "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
     "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
-    "FSDPStrategy", "MeshStrategy", "Trainer", "TpuModule", "TpuDataModule",
+    "FSDPStrategy", "MeshStrategy", "SequenceParallelStrategy", "Trainer",
+    "TpuModule", "TpuDataModule",
     "Callback", "ModelCheckpoint", "EpochStatsCallback", "seed_everything",
     "RayLauncher", "LocalLauncher"
 ]

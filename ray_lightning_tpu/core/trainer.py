@@ -252,6 +252,11 @@ class Trainer:
         """
         strategy = self.strategy
         mesh = strategy.mesh
+        # register the mesh for attention_impl='ring': models nest a
+        # shard_map over the sp axis inside the jitted step (no-op when
+        # the mesh has no sp axis)
+        from ray_lightning_tpu.parallel import ring_attention as _ring
+        _ring.set_sp_mesh(mesh)
         module = self._module
         model = module.configure_model()
         self._model = model

@@ -8,11 +8,15 @@ from ray_lightning_tpu.models.gpt import GPTModule, gpt2_config, count_params
 from ray_lightning_tpu.models.bert import BertModule, BertClassifier, bert_config
 from ray_lightning_tpu.models.resnet import (ResNetModule, resnet18,
                                              resnet50)
+from ray_lightning_tpu.models.moe import (MoeConfig, MoeModule,
+                                          MoeTransformerLM,
+                                          expert_parallel_rule, moe_config)
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
     "MNISTClassifier", "TransformerConfig", "TransformerLM",
     "TransformerEncoder", "GPTModule", "gpt2_config", "count_params",
     "BertModule", "BertClassifier", "bert_config", "ResNetModule",
-    "resnet18", "resnet50"
+    "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
+    "expert_parallel_rule", "moe_config"
 ]

@@ -80,8 +80,10 @@ class GPTModule(TpuModule):
     def _loader(self, seed: int, shuffle: bool = False):
         toks = synthetic_tokens(self.num_samples, self.seq_len + 1,
                                 self.cfg.vocab_size, seed=seed)
-        return DataLoader(ArrayDataset(toks), batch_size=self.batch_size,
-                          shuffle=shuffle)
+        # pre-split (inputs, targets): every batch leaf is (B, seq_len), so
+        # sequence-dim sharding (SequenceParallelStrategy) divides evenly
+        return DataLoader(ArrayDataset((toks[:, :-1], toks[:, 1:])),
+                          batch_size=self.batch_size, shuffle=shuffle)
 
     def train_dataloader(self):
         return self._loader(0, shuffle=True)
@@ -90,10 +92,10 @@ class GPTModule(TpuModule):
         return self._loader(1)
 
     def init_variables(self, model, rng, batch):
-        return model.init(rng, batch[:, :-1])
+        return model.init(rng, batch[0])
 
     def _loss(self, model, variables, batch, rng, deterministic):
-        inputs, targets = batch[:, :-1], batch[:, 1:]
+        inputs, targets = batch
         rngs = {"dropout": rng} if self.cfg.dropout > 0 else None
         logits = model.apply(variables, inputs,
                              deterministic=deterministic, rngs=rngs)

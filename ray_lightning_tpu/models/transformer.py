@@ -59,8 +59,9 @@ def _attention_fn(cfg: TransformerConfig):
         from ray_lightning_tpu.ops.flash_attention import flash_attention
         return flash_attention
     if cfg.attention_impl == "ring":
-        from ray_lightning_tpu.parallel.ring_attention import ring_attention
-        return ring_attention
+        from ray_lightning_tpu.parallel.ring_attention import (
+            sp_sharded_attention)
+        return sp_sharded_attention
     raise ValueError(f"Unknown attention_impl {cfg.attention_impl!r}")
 
 

@@ -53,11 +53,12 @@ def dot_product_attention(q: jax.Array,
     if mask is not None:
         logits = logits + mask.astype(softmax_dtype)
     weights = jax.nn.softmax(logits.astype(softmax_dtype), axis=-1)
-    if causal or mask is not None:
-        # Fully-masked rows (e.g. end-aligned causal with q_len > kv_len):
-        # softmax of all -inf is uniform garbage; emit exactly 0 instead —
-        # the same convention as the flash kernels, so impls are swappable.
-        # Statically impossible without a mask, so gated at trace time.
+    if (causal and q.shape[1] > k.shape[1]) or mask is not None:
+        # Fully-masked rows (end-aligned causal with q_len > kv_len, or a
+        # user mask): softmax of all -inf is uniform garbage; emit exactly
+        # 0 instead — the same convention as the flash kernels, so impls
+        # are swappable. Statically impossible when q_len <= kv_len and no
+        # mask is given, so the hot path skips the reduction at trace time.
         all_masked = jnp.all(logits <= jnp.finfo(softmax_dtype).min * 0.5,
                              axis=-1, keepdims=True)
         weights = jnp.where(all_masked, 0.0, weights)

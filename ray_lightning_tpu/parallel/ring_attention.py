@@ -8,10 +8,17 @@ online-softmax partial results for its local queries — attention over the
 full sequence with O(T/N) activation memory per chip and communication
 overlapped across steps.
 
-Must run inside ``shard_map`` with the ``sp`` axis bound (the
-SequenceParallelStrategy does this); called with no axis bound it falls back
-to plain attention, so models can enable ``attention_impl='ring'``
-unconditionally.
+:func:`ring_attention` must run inside ``shard_map`` with the ``sp`` axis
+bound; called with no axis bound it falls back to plain attention, so models
+can enable ``attention_impl='ring'`` unconditionally.
+:func:`sp_sharded_attention` is the training-path entry
+(``TransformerConfig.attention_impl='ring'`` resolves to it): when the
+trainer has registered a mesh with an ``sp`` axis (``set_sp_mesh``, done by
+``Trainer._setup_state``), it nests a ``shard_map`` over just the attention
+call inside the jitted train step — the rest of the model stays GSPMD
+(positions, embeddings, loss all see global shapes) while K/V genuinely
+rotate around the ring via ``ppermute``. ``SequenceParallelStrategy``
+provides the matching ``dp×sp`` batch layout.
 """
 from __future__ import annotations
 
@@ -19,12 +26,62 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_lightning_tpu.ops.attention import dot_product_attention
 from ray_lightning_tpu.ops.flash_attention import (_BIG_NEG, _block_update,
                                                    _finalize)
 
 SP_AXIS_NAME = "sp"
+
+# Mesh registered by the trainer (worker-side, at step-build time) so model
+# code can nest a shard_map without threading the mesh through configs —
+# configs stay pure data and client-mode drivers never build a mesh.
+_SP_MESH: Optional[Mesh] = None
+
+
+def set_sp_mesh(mesh: Optional[Mesh]) -> None:
+    global _SP_MESH
+    _SP_MESH = mesh
+
+
+def get_sp_mesh() -> Optional[Mesh]:
+    if _SP_MESH is not None and SP_AXIS_NAME in _SP_MESH.axis_names \
+            and _SP_MESH.shape[SP_AXIS_NAME] > 1:
+        return _SP_MESH
+    return None
+
+
+def sp_sharded_attention(q: jax.Array,
+                         k: jax.Array,
+                         v: jax.Array,
+                         *,
+                         causal: bool = False,
+                         mask: Optional[jax.Array] = None,
+                         dropout_rate: float = 0.0,
+                         dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+    """Ring attention over the registered sp mesh; plain attention without
+    one. Global shapes (B, T, H, D) — the shard_map is internal."""
+    mesh = get_sp_mesh()
+    if mesh is None or mask is not None or (
+            dropout_rate > 0.0 and dropout_rng is not None):
+        return ring_attention(q, k, v, causal=causal, mask=mask,
+                              dropout_rate=dropout_rate,
+                              dropout_rng=dropout_rng)
+    if q.shape[1] % mesh.shape[SP_AXIS_NAME] != 0:
+        return ring_attention(q, k, v, causal=causal)
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    data_size = 1
+    for a in data_axes:
+        data_size *= mesh.shape[a]
+    if data_size > 1 and q.shape[0] % data_size != 0:
+        return ring_attention(q, k, v, causal=causal)
+    spec = P(data_axes if data_axes else None, SP_AXIS_NAME)
+    fn = jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
 
 
 def ring_attention(q: jax.Array,

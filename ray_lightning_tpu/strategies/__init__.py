@@ -6,9 +6,11 @@ from ray_lightning_tpu.strategies.allreduce import (HorovodRayStrategy,
                                                     AllReduceStrategy)
 from ray_lightning_tpu.strategies.fsdp import FSDPStrategy
 from ray_lightning_tpu.strategies.mesh_strategy import MeshStrategy
+from ray_lightning_tpu.strategies.sequence_parallel import (
+    SequenceParallelStrategy)
 
 __all__ = [
     "Strategy", "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",
     "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
-    "FSDPStrategy", "MeshStrategy"
+    "FSDPStrategy", "MeshStrategy", "SequenceParallelStrategy"
 ]

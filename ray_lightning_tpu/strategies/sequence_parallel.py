@@ -1,0 +1,80 @@
+"""Sequence/context parallelism: the long-context strategy.
+
+Net-new beyond the reference (SURVEY.md §5: long-context "entirely absent"
+upstream), first-class here per the TPU design brief. The layout is a
+``dp×sp`` mesh: the batch dim splits over ``dp`` and the *sequence* dim
+splits over ``sp``, so per-chip activation memory scales O(T / sp) — the
+lever that makes million-token contexts fit.
+
+Two attention paths compose with it:
+
+- ``attention_impl='ring'`` (recommended): the model nests a ``shard_map``
+  over ``sp`` around each attention call and K/V shards rotate via
+  ``lax.ppermute`` ICI neighbor hops (``parallel/ring_attention.py``) —
+  communication overlaps the blockwise compute, nothing materializes the
+  full sequence;
+- ``attention_impl='dot'``: plain GSPMD — XLA all-gathers K/V over ``sp``
+  inside the jitted step. Correct, simpler, and fine at moderate lengths.
+
+Everything else (embeddings, layernorms, MLP, loss) is token-local, so the
+standard jit-with-shardings path handles it: the strategy only owes the
+batch layout and a rank model in which *data* replicas = dp (sequence
+shards see the same samples).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.strategies.mesh_strategy import MeshStrategy
+
+
+class SequenceParallelStrategy(MeshStrategy):
+    """``dp×sp`` mesh with the sequence dim of every batch leaf sharded.
+
+    Args:
+        dp: data-parallel size (batch split). ``-1`` absorbs remaining
+            devices.
+        sp: sequence-parallel size (sequence split).
+        seq_dim: which batch-leaf dim is the sequence (default 1 — the
+            (batch, seq, ...) convention every bundled model uses). Batch
+            leaves must have at least ``seq_dim + 1`` dims.
+    """
+    strategy_name = "sequence_parallel_tpu"
+
+    def __init__(self, dp: int = 1, sp: int = 2, seq_dim: int = 1,
+                 **kwargs):
+        if sp < 2:
+            raise ValueError(
+                "SequenceParallelStrategy needs sp >= 2; use RayStrategy "
+                "or MeshStrategy for pure data parallelism")
+        super().__init__(axes={"dp": dp, "sp": sp}, **kwargs)
+        self.seq_dim = int(seq_dim)
+
+    def batch_sharding(self) -> NamedSharding:
+        spec = [None] * (self.seq_dim + 1)
+        spec[0] = "dp"
+        spec[self.seq_dim] = "sp"
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def distributed_sampler_kwargs(self) -> Dict[str, int]:
+        """Data replicas = dp only: every sp shard holds (a slice of) the
+        same samples, so host-side feeding must not skip over them.
+
+        The sampler rank is the *dp coordinate*, not the flat global rank:
+        the mesh is dp-major with contiguous per-process device blocks
+        (asserted at mesh build), so process r sits in dp slice
+        ``r // sp`` — its sp peers get the same rank and load the same
+        samples. (The default input path, ``put_global_batch``, feeds every
+        process the full global batch and transfers only owned shards, so
+        these kwargs matter only for rank-sliced custom loaders.)
+        """
+        dp = self._axes["dp"]
+        sp = self._axes["sp"]
+        if dp == -1:
+            # wildcard resolves against devices — worker-side only (a
+            # client-mode driver passes a fixed dp and never gets here)
+            dp = self.mesh.shape["dp"]
+        return dict(num_replicas=dp, rank=self.global_rank // sp)

@@ -116,26 +116,43 @@ def test_worker_exception_fails_fast(tmp_path):
         ray_mod.shutdown()
 
 
-def _sleep_and_pid(seconds: float):
-    time.sleep(seconds)
-    return os.getpid()
+def _meet_at_files(dirpath: str, my_id: int, other_id: int,
+                   timeout: float = 30.0):
+    """Cross-process rendezvous: announce myself, wait to see the peer.
+
+    Succeeds only if both tasks are IN FLIGHT at the same time — a serial
+    backend runs task 0 to completion first, so it times out waiting for a
+    peer that was never dispatched. Load-robust, unlike wall-clock bounds
+    (this test flaked under parallel-suite load with a dt assertion).
+    """
+    mine = os.path.join(dirpath, str(my_id))
+    other = os.path.join(dirpath, str(other_id))
+    with open(mine, "w"):
+        pass
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(other):
+            return os.getpid()
+        time.sleep(0.01)
+    return None
 
 
 @pytest.mark.multiproc
-def test_actors_execute_concurrently():
+def test_actors_execute_concurrently(tmp_path):
     """Round-1 gap: the fake backend was synchronous, so concurrent dispatch
-    was never covered. Two process actors sleeping 1s each must finish in
-    well under 2s, in distinct processes."""
+    was never covered. Two process actors must be in flight simultaneously
+    (mutual rendezvous), in distinct non-driver processes."""
     ray_mod = _make_backend()
     ray_mod.init()
     try:
         from ray_lightning_tpu.launchers.ray_launcher import ExecutorBase
         actors = [ray_mod.remote(ExecutorBase).remote() for _ in range(2)]
-        t0 = time.perf_counter()
-        futures = [a.execute.remote(_sleep_and_pid, 1.0) for a in actors]
+        futures = [
+            a.execute.remote(_meet_at_files, str(tmp_path), i, 1 - i)
+            for i, a in enumerate(actors)
+        ]
         pids = ray_mod.get(futures)
-        dt = time.perf_counter() - t0
-        assert dt < 1.8, f"actors ran serially ({dt:.2f}s for 2x 1s sleeps)"
+        assert None not in pids, "actors never overlapped (serial backend?)"
         assert len(set(pids)) == 2
         assert os.getpid() not in pids
     finally:
