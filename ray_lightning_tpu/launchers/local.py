@@ -48,6 +48,10 @@ class LocalLauncher:
         finally:
             self.drain_queue()
             _session.shutdown_session()
+            # parity with RayLauncher.launch: teardown releases the mesh
+            # and the ring-attention mesh registration (meshes rebuild
+            # lazily on the next use, so this is cleanup, not state loss)
+            self._strategy.teardown()
         return result
 
     def drain_queue(self) -> None:

@@ -56,6 +56,42 @@ def expert_parallel_rule(path, leaf):
     return P()
 
 
+def route_top_k(probs: jax.Array, capacity: int,
+                top_k: int) -> tuple[jax.Array, jax.Array]:
+    """Static-shape GShard/Switch routing: ``(dispatch, combine)``.
+
+    Greedy top-k slot assignment: for each of the k slots, take the argmax
+    over the not-yet-used experts, place the token at its expert's next
+    free capacity position (cumsum trick), and zero that expert out for
+    the next slot. Both outputs are ``(N, E, C)``; ``dispatch`` is 0/1,
+    ``combine`` carries the router probability of the chosen expert.
+    Pure function — unit-tested directly (combine mass per kept token ==
+    sum of its top-k probs; per-expert load <= capacity).
+    """
+    N, E = probs.shape
+    remaining = probs
+    dispatch = jnp.zeros((N, E, capacity), dtype=jnp.float32)
+    combine = jnp.zeros((N, E, capacity), dtype=jnp.float32)
+    # position base: tokens claimed by earlier slots per expert
+    claimed = jnp.zeros((E,), dtype=jnp.int32)
+    for _ in range(top_k):
+        expert_idx = jnp.argmax(remaining, axis=-1)        # (N,)
+        onehot = jax.nn.one_hot(expert_idx, E,
+                                dtype=jnp.float32)         # (N, E)
+        gate = jnp.sum(probs * onehot, axis=-1)            # (N,)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # 0-based
+        pos = pos + claimed[None, :].astype(jnp.float32) * onehot
+        keep = (pos < capacity).astype(jnp.float32) * onehot
+        pos_idx = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+        slot = keep[:, :, None] * jax.nn.one_hot(
+            pos_idx, capacity, dtype=jnp.float32)          # (N, E, C)
+        dispatch = dispatch + slot
+        combine = combine + slot * gate[:, None, None]
+        claimed = claimed + jnp.sum(onehot, axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+    return dispatch, combine
+
+
 class MoeMlp(nn.Module):
     """Top-k routed expert FFN bank. Returns ``(out, aux_loss)``."""
     cfg: MoeConfig
@@ -74,31 +110,7 @@ class MoeMlp(nn.Module):
                                  param_dtype=cfg.param_dtype,
                                  name="router")(tokens.astype(jnp.float32))
         probs = jax.nn.softmax(router_logits, axis=-1)        # (N, E) f32
-
-        # Greedy top-k slot assignment with static shapes: for each of the
-        # k slots, take the argmax over the not-yet-used experts, place the
-        # token at its expert's next free capacity position (cumsum trick),
-        # and zero it out for the next slot.
-        remaining = probs
-        dispatch = jnp.zeros((N, E, capacity), dtype=jnp.float32)
-        combine = jnp.zeros((N, E, capacity), dtype=jnp.float32)
-        # position base: tokens claimed by earlier slots per expert
-        claimed = jnp.zeros((E,), dtype=jnp.int32)
-        for _ in range(k):
-            expert_idx = jnp.argmax(remaining, axis=-1)        # (N,)
-            onehot = jax.nn.one_hot(expert_idx, E,
-                                    dtype=jnp.float32)         # (N, E)
-            gate = jnp.sum(probs * onehot, axis=-1)            # (N,)
-            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # 0-based
-            pos = pos + claimed[None, :].astype(jnp.float32) * onehot
-            keep = (pos < capacity).astype(jnp.float32) * onehot
-            pos_idx = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
-            slot = keep[:, :, None] * jax.nn.one_hot(
-                pos_idx, capacity, dtype=jnp.float32)          # (N, E, C)
-            dispatch = dispatch + slot
-            combine = combine + slot * gate[:, None, None]
-            claimed = claimed + jnp.sum(onehot, axis=0).astype(jnp.int32)
-            remaining = remaining * (1.0 - onehot)
+        dispatch, combine = route_top_k(probs, capacity, k)
 
         # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob e)
         frac = jnp.mean(

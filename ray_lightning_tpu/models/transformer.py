@@ -52,6 +52,47 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
+def tensor_parallel_rule(path, leaf):
+    """Megatron-style tensor-parallel PartitionSpec rule for this module
+    family, for ``MeshStrategy(axes={"dp": ..., "tp": ...},
+    param_rule=tensor_parallel_rule)``.
+
+    Column-parallel up-projections (attention qkv over the heads dim, MLP
+    ``up`` over d_ff) and row-parallel down-projections (attention ``out``
+    and MLP ``down`` over their input dim) — so each block needs exactly
+    one all-reduce in forward, which GSPMD inserts from these specs.
+    Negative dim indexing makes the same rule cover scanned stacks (the
+    leading ``layers`` dim the ``nn.scan`` adds) and unrolled blocks.
+    Embeddings/layernorms replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    names = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+    shape = tuple(getattr(leaf, "shape", ()))
+    if not shape:
+        return P()
+
+    def at(dim):
+        spec = [None] * len(shape)
+        spec[dim] = "tp"
+        return P(*spec)
+
+    leafname = names[-1]
+    if "attn" in names and "qkv" in names:
+        # kernel (..., d_model, 3, H, Dh), bias (..., 3, H, Dh): heads dim
+        return at(-2)
+    if "attn" in names and "out" in names:
+        # kernel (..., H*Dh, d_model) row-parallel; bias replicated
+        return at(-2) if leafname == "kernel" and len(shape) >= 2 else P()
+    if "mlp" in names and "up" in names:
+        # kernel (..., d_model, d_ff), bias (..., d_ff): d_ff dim
+        return at(-1)
+    if "mlp" in names and "down" in names:
+        # kernel (..., d_ff, d_model) row-parallel; bias replicated
+        return at(-2) if leafname == "kernel" and len(shape) >= 2 else P()
+    return P()
+
+
 def _attention_fn(cfg: TransformerConfig):
     if cfg.attention_impl == "dot":
         return dot_product_attention

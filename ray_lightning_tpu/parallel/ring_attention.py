@@ -63,11 +63,19 @@ def sp_sharded_attention(q: jax.Array,
     """Ring attention over the registered sp mesh; plain attention without
     one. Global shapes (B, T, H, D) — the shard_map is internal."""
     mesh = get_sp_mesh()
-    if mesh is None or mask is not None or (
-            dropout_rate > 0.0 and dropout_rng is not None):
+    if mesh is None:
         return ring_attention(q, k, v, causal=causal, mask=mask,
                               dropout_rate=dropout_rate,
                               dropout_rng=dropout_rng)
+    if mask is not None or (dropout_rate > 0.0 and dropout_rng is not None):
+        # Falling back to full attention here would silently re-materialize
+        # O(T) per-chip attention memory — an OOM, not a slowdown, at the
+        # lengths sequence parallelism targets. Fail loudly instead.
+        raise NotImplementedError(
+            "attention_impl='ring' under a sequence-parallel mesh supports "
+            "neither attention dropout nor custom masks (K/V shards "
+            "rotate; no global score matrix exists to mask). Set "
+            "dropout=0.0 / drop the mask, or use attention_impl='dot'.")
     if q.shape[1] % mesh.shape[SP_AXIS_NAME] != 0:
         return ring_attention(q, k, v, causal=causal)
     data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
@@ -76,7 +84,14 @@ def sp_sharded_attention(q: jax.Array,
         data_size *= mesh.shape[a]
     if data_size > 1 and q.shape[0] % data_size != 0:
         return ring_attention(q, k, v, causal=causal)
-    spec = P(data_axes if data_axes else None, SP_AXIS_NAME)
+    # keep heads tp-sharded through the ring when a tp axis exists (ring
+    # attention is independent per head) — otherwise the shard_map boundary
+    # all-gathers the heads dim and every tp peer redundantly runs the ring
+    head_axis = None
+    if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 \
+            and q.shape[2] % mesh.shape["tp"] == 0:
+        head_axis = "tp"
+    spec = P(data_axes if data_axes else None, SP_AXIS_NAME, head_axis)
     fn = jax.shard_map(
         lambda a, b, c: ring_attention(a, b, c, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -76,6 +76,12 @@ class MeshStrategy(Strategy):
 
     def opt_state_sharding(self, abstract_opt_state: Any) -> Any:
         mesh = self.mesh
+        if self._param_rule is not None:
+            # Optimizer moments mirror the params pytree, so param paths
+            # appear as suffixes of opt-state paths and the same rule
+            # lands the same layout (scalars/counters match nothing → P()).
+            return shardlib.apply_rule(abstract_opt_state, mesh,
+                                       self._param_rule)
         if FSDP_AXIS in mesh.axis_names and mesh.shape[FSDP_AXIS] > 1:
             return shardlib.shard_pytree_along_axis(abstract_opt_state, mesh,
                                                     FSDP_AXIS)

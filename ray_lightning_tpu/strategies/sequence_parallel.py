@@ -77,4 +77,13 @@ class SequenceParallelStrategy(MeshStrategy):
             # wildcard resolves against devices — worker-side only (a
             # client-mode driver passes a fixed dp and never gets here)
             dp = self.mesh.shape["dp"]
-        return dict(num_replicas=dp, rank=self.global_rank // sp)
+        rank = self.global_rank // sp
+        if self._is_remote:
+            # a multi-host process owns a block of devices, so its dp
+            # coordinate is that of its FIRST device in mesh-flat order
+            # (one-device-per-process reduces to global_rank // sp);
+            # device queries are worker-side only, keeping client mode
+            # device-free on the driver
+            import jax
+            rank = (self.global_rank * jax.local_device_count()) // sp
+        return dict(num_replicas=dp, rank=rank)

@@ -34,34 +34,45 @@ def test_single_expert_is_dense_mlp():
 
 
 def test_combine_weights_are_router_probs():
-    """With ample capacity nothing drops: each token's total combine weight
-    equals the sum of its top-k router probabilities."""
+    """With ample capacity nothing drops: each token's total combine mass
+    equals the sum of its top-k router probabilities exactly, dispatch
+    mass is k per token, and per-expert load never exceeds capacity."""
+    from ray_lightning_tpu.models.moe import route_top_k
+
+    N, E, k, capacity = 32, 4, 2, 64  # capacity >> N: drop-free
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(2), (N, E)), axis=-1)
+    dispatch, combine = route_top_k(probs, capacity, k)
+
+    topk = jnp.sum(jnp.sort(probs, axis=-1)[:, -k:], axis=-1)
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))),
+                               np.asarray(topk), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(jnp.sum(dispatch, axis=(1, 2))),
+                               np.full(N, float(k)), rtol=0, atol=0)
+    # each (expert, slot) pair holds at most one token
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0
+
+    # and the layer using it still produces finite output + balanced aux
     cfg = moe_config("nano", n_experts=4, expert_top_k=2,
                      capacity_factor=8.0, dtype=jnp.float32)
     x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, cfg.d_model))
-    layer = MoeMlp(cfg)
-    variables = layer.init(jax.random.PRNGKey(0), x)
-    tokens = x.reshape(-1, cfg.d_model)
-    logits = tokens.astype(jnp.float32) @ \
-        variables["params"]["router"]["kernel"] + \
-        variables["params"]["router"]["bias"]
-    probs = jax.nn.softmax(logits, axis=-1)
-    topk = jnp.sum(jnp.sort(probs, axis=-1)[:, -2:], axis=-1)
-
-    # re-derive combine mass by pushing an all-ones value bank through:
-    # easier — capture via the public API: out with identity experts is
-    # hard; instead assert drop-free dispatch mass == k per token
     _, out, aux = _run_mlp(cfg, x)
     assert np.isfinite(np.asarray(out)).all()
     assert float(aux) >= 1.0 - 1e-5  # Switch aux lower bound at balance
 
-    # dispatch mass: run the routing math the layer uses
-    # (capacity 8x ⇒ nothing dropped ⇒ every token keeps k slots)
-    # verified indirectly: gradient flows to every expert used
-    g = jax.grad(lambda v: jnp.sum(layer.apply(v, x)[0] ** 2))(variables)
-    up = g["params"]["experts_up"]
-    assert np.asarray(jnp.any(up != 0, axis=(1, 2))).sum() >= 2
-    del topk
+
+def test_route_respects_capacity():
+    from ray_lightning_tpu.models.moe import route_top_k
+
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(5), (64, 2)), axis=-1)
+    dispatch, combine = route_top_k(probs, capacity=3, top_k=1)
+    per_expert = jnp.sum(dispatch, axis=(0, 2))
+    assert float(jnp.max(per_expert)) <= 3.0
+    # dropped tokens carry zero combine mass
+    kept = jnp.sum(dispatch, axis=(1, 2))
+    dropped_mass = jnp.sum(combine, axis=(1, 2)) * (1 - kept)
+    assert float(jnp.max(dropped_mass)) == 0.0
 
 
 def test_capacity_drops_overflow_tokens():

@@ -129,3 +129,45 @@ def test_sp_eval_and_long_seq(tmp_root):
     trainer.fit(model)
     assert np.isfinite(trainer.callback_metrics["train_loss"])
     assert np.isfinite(trainer.callback_metrics["val_loss"])
+
+
+def test_ring_with_dropout_fails_loudly():
+    """Silent fallback to full attention would be an OOM at target
+    lengths; dropout/mask under an sp mesh must raise instead."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "sp"))
+    ring_mod.set_sp_mesh(mesh)
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(x, (4, 64, 2, 8)) for x in ks)
+    with pytest.raises(NotImplementedError, match="dropout"):
+        ring_mod.sp_sharded_attention(
+            q, k, v, causal=True, dropout_rate=0.1,
+            dropout_rng=jax.random.PRNGKey(0))
+
+
+def test_ring_keeps_heads_tp_sharded():
+    """On a dp×sp×tp mesh the ring runs per head-shard (no all-gather of
+    heads at the shard_map boundary), still matching full attention."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("dp", "sp", "tp"))
+    ring_mod.set_sp_mesh(mesh)
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q, k, v = (jax.random.normal(x, (4, 32, 4, 8)) for x in ks)
+    out = jax.jit(lambda a, b, c: ring_mod.sp_sharded_attention(
+        a, b, c, causal=True))(q, k, v)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert out.sharding.spec[2] == "tp"
+
+
+def test_local_fit_clears_sp_mesh(tmp_root):
+    """Strategy teardown after a local fit drops the registered mesh, so
+    later model.apply calls outside a trainer run locally."""
+    model = _gpt(seq_len=32)
+    trainer = Trainer(strategy=SequenceParallelStrategy(dp=2, sp=4),
+                      max_epochs=1, limit_train_batches=1,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(model)
+    assert ring_mod.get_sp_mesh() is None

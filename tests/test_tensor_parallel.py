@@ -1,0 +1,107 @@
+"""Megatron-style tensor parallelism for the transformer family."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import MeshStrategy, RayStrategy, Trainer
+from ray_lightning_tpu.models import GPTModule, gpt2_config
+from ray_lightning_tpu.models.transformer import tensor_parallel_rule
+
+
+def _fit(strategy, tmp_root, scan_layers=True, seed=7):
+    import optax
+
+    class SgdGpt(GPTModule):
+        def configure_optimizers(self):
+            return optax.sgd(0.1)
+
+    cfg = gpt2_config("nano", vocab_size=128, max_seq_len=32,
+                      scan_layers=scan_layers, dtype=jnp.float32)
+    model = SgdGpt(config=cfg, batch_size=8, seq_len=32, num_samples=64)
+    trainer = Trainer(strategy=strategy, max_epochs=1,
+                      limit_train_batches=4, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      default_root_dir=tmp_root, seed=seed)
+    trainer.fit(model)
+    return trainer
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_tp_layout(tmp_root, scan_layers):
+    """qkv/up column-parallel, out/down row-parallel, on both the scanned
+    stack (leading layers dim) and unrolled blocks."""
+    trainer = _fit(MeshStrategy(axes={"dp": 4, "tp": 2},
+                                param_rule=tensor_parallel_rule),
+                   tmp_root, scan_layers=scan_layers)
+    flat = jax.tree_util.tree_flatten_with_path(
+        trainer.train_state.params)[0]
+    checked = 0
+    for path, leaf in flat:
+        names = "/".join(str(getattr(p, "key", p)) for p in path)
+        spec = leaf.sharding.spec
+        if "qkv" in names and names.endswith("kernel"):
+            assert spec[-2] == "tp", (names, spec)   # heads dim
+            checked += 1
+        elif "out" in names and names.endswith("kernel"):
+            assert spec[-2] == "tp", (names, spec)   # row-parallel input
+            checked += 1
+        elif "up" in names and names.endswith("kernel"):
+            assert spec[-1] == "tp", (names, spec)   # d_ff dim
+            checked += 1
+        elif "down" in names and names.endswith("kernel"):
+            assert spec[-2] == "tp", (names, spec)
+            checked += 1
+        elif "embed" in names.lower() or "wte" in names or "ln" in names:
+            assert all(s is None for s in spec), (names, spec)
+    assert checked >= 4
+
+    # optimizer moments follow the params layout (same rule applied)
+    opt_flat = jax.tree_util.tree_flatten_with_path(
+        trainer.train_state.opt_state)[0]
+    tp_opt = [l for p, l in opt_flat
+              if "qkv" in "/".join(str(getattr(x, "key", x)) for x in p)
+              and l.ndim >= 2 and "tp" in [s for s in l.sharding.spec
+                                           if s is not None]]
+    # sgd has no moments; layout rule still must not crash on counters
+    del tp_opt
+
+
+def test_tp_matches_ddp(tmp_root):
+    """dp×tp training ≡ plain DDP (layout, not algorithm)."""
+    p_tp = jax.device_get(_fit(
+        MeshStrategy(axes={"dp": 4, "tp": 2},
+                     param_rule=tensor_parallel_rule),
+        tmp_root).train_state.params)
+    p_ddp = jax.device_get(_fit(RayStrategy(num_workers=4),
+                                tmp_root).train_state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(p_tp),
+                    jax.tree_util.tree_leaves(p_ddp)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_tp_with_adam_opt_state_sharded(tmp_root):
+    """Adam moments land tp-sharded via the same rule (memory parity with
+    the param layout)."""
+    cfg = gpt2_config("nano", vocab_size=128, max_seq_len=32,
+                      dtype=jnp.float32)
+    model = GPTModule(config=cfg, batch_size=8, seq_len=32, num_samples=32)
+    trainer = Trainer(strategy=MeshStrategy(
+                          axes={"dp": 4, "tp": 2},
+                          param_rule=tensor_parallel_rule),
+                      max_epochs=1, limit_train_batches=1,
+                      limit_val_batches=0, num_sanity_val_steps=0,
+                      enable_checkpointing=False,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(model)
+    opt_flat = jax.tree_util.tree_flatten_with_path(
+        trainer.train_state.opt_state)[0]
+    sharded = [
+        "/".join(str(getattr(x, "key", x)) for x in p)
+        for p, l in opt_flat
+        if l.ndim >= 2 and any(s == "tp" for s in l.sharding.spec)
+    ]
+    assert any("qkv" in s for s in sharded)
+    assert any("up" in s for s in sharded)
