@@ -24,7 +24,7 @@ from ray_lightning_tpu.core.trainer import Trainer
 from ray_lightning_tpu.strategies import (AllReduceStrategy, FSDPStrategy,
                                           HorovodRayStrategy, MeshStrategy,
                                           RayShardedStrategy, RayStrategy,
-                                          Strategy)
+                                          SequenceParallelStrategy, Strategy)
 
 #: name → class; keys are the strategies' ``strategy_name`` plus the
 #: TPU-native aliases (parity: PTL's StrategyRegistry entries the reference
@@ -45,6 +45,7 @@ if AllReduceStrategy is not HorovodRayStrategy:
 register_strategy(RayShardedStrategy, "ddp_sharded", "zero1")
 register_strategy(FSDPStrategy, "fsdp")
 register_strategy(MeshStrategy, "mesh")
+register_strategy(SequenceParallelStrategy, "sp", "sequence_parallel")
 
 
 _TRUE = ("true", "1", "yes", "y", "on")

@@ -60,6 +60,7 @@ def load_sharded_checkpoint(dirpath: str,
     pytree of ``jax.ShapeDtypeStruct`` + shardings, orbax restores straight
     into the sharded layout with no host round-trip.
     """
+    import numpy as np
     import orbax.checkpoint as ocp
 
     dirpath = os.path.abspath(dirpath)
@@ -68,7 +69,16 @@ def load_sharded_checkpoint(dirpath: str,
     if target is not None:
         state = ckptr.restore(state_path, target)
     else:
-        state = ckptr.restore(state_path)
+        # Restore to host numpy EXPLICITLY: a bare restore replays the
+        # saving run's device layout, which fails whenever the resuming
+        # world differs (e.g. a 2-process save resumed single-process —
+        # the worker-count-resize path this format exists for).
+        meta = ckptr.metadata(state_path)
+        meta_tree = getattr(meta, "item_metadata", meta)
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
+        state = ocp.PyTreeCheckpointer().restore(state_path,
+                                                 restore_args=restore_args)
     meta_path = os.path.join(dirpath, _META_NAME)
     meta: Dict[str, Any] = {}
     if os.path.exists(meta_path):
