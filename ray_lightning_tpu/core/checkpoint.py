@@ -73,8 +73,8 @@ def load_sharded_checkpoint(dirpath: str,
         # saving run's device layout, which fails whenever the resuming
         # world differs (e.g. a 2-process save resumed single-process —
         # the worker-count-resize path this format exists for).
-        meta = ckptr.metadata(state_path)
-        meta_tree = getattr(meta, "item_metadata", meta)
+        state_meta = ckptr.metadata(state_path)
+        meta_tree = getattr(state_meta, "item_metadata", state_meta)
         restore_args = jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
         state = ocp.PyTreeCheckpointer().restore(state_path,

@@ -1,0 +1,104 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp`` axis.
+
+Net-new beyond the reference (SURVEY.md §2.3: PP absent upstream). The
+``pp`` mesh axis splits the *layer* dimension: stage ``s`` owns layers
+``[s·L/S, (s+1)·L/S)``. :func:`pipeline_apply` runs the classic
+collective-permute schedule inside ``shard_map``:
+
+- the loop runs ``M + S - 1`` ticks for ``M`` microbatches over ``S``
+  stages; at each tick every stage applies its layer block to the
+  activation it holds, then the activations rotate one hop along the ring
+  (``lax.ppermute``) — stage 0 injects microbatch ``t``, the last stage
+  retires microbatch ``t - (S-1)``;
+- the schedule is a ``lax.scan``, so **jax autodiff derives the pipelined
+  backward automatically** (the transpose of ppermute is the reverse hop;
+  the backward bubble mirrors the forward one);
+- warm-up/drain ticks compute on garbage activations (static shapes — the
+  TPU way); their outputs are masked out of the result and, because the
+  output selects only retired ticks, autodiff sends exactly zero cotangent
+  back through them.
+
+The bubble fraction is ``(S-1)/(M+S-1)`` — pick ``M ≫ S``. Communication
+is one activation-sized neighbor hop per tick, riding ICI.
+
+This is the building block: it is pure jax (params in, activations out), so
+it slots under any step built with ``shard_map`` — see
+``tests/test_pipeline.py`` for a full pipelined training step (loss +
+grads + psum across dp×pp) driven this way.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+PP_AXIS_NAME = "pp"
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any,
+                   microbatches: jax.Array,
+                   *,
+                   axis_name: str = PP_AXIS_NAME) -> jax.Array:
+    """Run ``microbatches`` through an ``S``-stage pipeline.
+
+    Must be called inside ``shard_map`` with ``axis_name`` bound.
+
+    Args:
+        stage_fn: ``(stage_params, x) -> y`` applying THIS stage's layer
+            block; ``y`` must have ``x``'s shape (residual-style stacks).
+        stage_params: this stage's parameters (already pp-sharded by the
+            caller's in_specs).
+        microbatches: ``(M, mb, ...)`` — the full microbatched input,
+            replicated across stages (only stage 0 reads it).
+
+    Returns:
+        ``(M, mb, ...)`` outputs, replicated across the pp group (a single
+        psum selects the last stage's retired activations).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    n_stages = jax.lax.axis_size(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    total_ticks = M + n_stages - 1
+
+    # ring: stage s sends to s+1; the wrap-around link carries no live data
+    perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # stage 0 injects microbatch t (clamped during drain ticks; the
+        # extra compute is masked out of `outputs` below)
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, M - 1), keepdims=False)
+        x = jnp.where(stage == 0, inject, recv)
+        y = stage_fn(stage_params, x)
+        # last stage retires microbatch t-(S-1) at ticks t >= S-1
+        out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        live = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(live, y,
+                      jax.lax.dynamic_index_in_dim(outputs, out_idx,
+                                                   keepdims=False)),
+            out_idx, axis=0)
+        recv = jax.lax.ppermute(y, axis_name, perm)
+        return (recv, outputs), None
+
+    init = (jnp.zeros(mb_shape, microbatches.dtype),
+            jnp.zeros((M,) + mb_shape, microbatches.dtype))
+    (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(total_ticks))
+    # only the last stage holds real outputs; one psum replicates them
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    return jax.lax.psum(outputs, axis_name)
+
+
+def split_microbatches(batch: jax.Array, n_microbatches: int) -> jax.Array:
+    """``(B, ...) -> (M, B/M, ...)`` leading-dim microbatch split."""
+    B = batch.shape[0]
+    if B % n_microbatches != 0:
+        raise ValueError(
+            f"batch size {B} not divisible by {n_microbatches} microbatches")
+    return batch.reshape((n_microbatches, B // n_microbatches)
+                         + batch.shape[1:])

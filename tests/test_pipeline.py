@@ -1,0 +1,132 @@
+"""GPipe-style pipeline parallelism over the ``pp`` axis."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_lightning_tpu.parallel.pipeline import (pipeline_apply,
+                                                 split_microbatches)
+
+
+def _block(p, x):
+    """One residual MLP layer: x + tanh(x @ W + b)."""
+    return x + jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stage_fn(stage_params, x):
+    """Apply this stage's stack of layers (leading dim = layers/stage)."""
+    def body(x, p):
+        return _block(p, x), None
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+def _stacked_params(n_layers, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), n_layers)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (d, d)) * 0.1 for k in ks]),
+        "b": jnp.zeros((n_layers, d)),
+    }
+
+
+def _serial_reference(params, x):
+    def body(x, p):
+        return _block(p, x), None
+    out, _ = jax.lax.scan(body, x, params)
+    return out
+
+
+def _pipelined(mesh, params, microbatches):
+    fn = jax.shard_map(
+        lambda p, mb: pipeline_apply(_stage_fn, p, mb),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    return jax.jit(fn)(params, microbatches)
+
+
+@pytest.mark.parametrize("n_stages,n_layers,n_micro", [(4, 8, 8), (2, 6, 4),
+                                                       (8, 8, 3)])
+def test_pipeline_matches_serial(n_stages, n_layers, n_micro):
+    """S-stage pipeline over M microbatches == serial layer stack, incl.
+    M < S (all-bubble) and uneven M vs S."""
+    d = 16
+    mesh = Mesh(np.array(jax.devices()[:n_stages]), ("pp",))
+    params = _stacked_params(n_layers, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (24, d))
+    mb = split_microbatches(x, n_micro)
+
+    out = _pipelined(mesh, params, mb)
+    want = _serial_reference(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out.reshape(-1, d)), np.asarray(want), rtol=2e-5,
+        atol=2e-5)
+
+
+def test_pipeline_grads_match_serial():
+    """Autodiff through the schedule: grads w.r.t. params and input match
+    the serial stack (the pipelined backward is derived, not hand-built)."""
+    d = 8
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    params = _stacked_params(8, d)
+    x = jax.random.normal(jax.random.PRNGKey(2), (16, d))
+    mb = split_microbatches(x, 8)
+
+    def pipe_loss(params, mb):
+        fn = jax.shard_map(
+            lambda p, m: pipeline_apply(_stage_fn, p, m),
+            mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+            check_vma=False)
+        return jnp.sum(fn(params, mb) ** 2)
+
+    def serial_loss(params, x):
+        return jnp.sum(_serial_reference(params, x) ** 2)
+
+    g_pipe = jax.jit(jax.grad(pipe_loss))(params, mb)
+    g_ser = jax.grad(serial_loss)(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_ser)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5,
+                                   atol=5e-5)
+
+
+def test_pipelined_training_step_dp_x_pp():
+    """A full dp×pp training step: batch split over dp, layers over pp,
+    grads psum'd over dp — loss decreases over a few SGD steps."""
+    d, n_layers = 8, 4
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "pp"))
+    params = _stacked_params(n_layers, d, seed=3)
+    x = jax.random.normal(jax.random.PRNGKey(4), (32, d))
+    y = jax.random.normal(jax.random.PRNGKey(5), (32, d)) * 0.1
+
+    def local_step(params, xb, yb):
+        mb_x = split_microbatches(xb, 4)
+
+        def loss_fn(p):
+            out = pipeline_apply(_stage_fn, p, mb_x)
+            return jnp.mean((out.reshape(yb.shape) - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss = jax.lax.pmean(loss, "dp")
+        grads = jax.lax.pmean(grads, "dp")
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params,
+                                     grads)
+        return new, loss
+
+    step = jax.jit(jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("pp"), P("dp"), P("dp")),
+        out_specs=(P("pp"), P()),
+        check_vma=False))
+
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_split_microbatches_validates():
+    with pytest.raises(ValueError, match="divisible"):
+        split_microbatches(jnp.zeros((10, 4)), 3)
+    assert split_microbatches(jnp.zeros((12, 4)), 3).shape == (3, 4, 4)
