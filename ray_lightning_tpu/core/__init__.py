@@ -1,12 +1,14 @@
 from ray_lightning_tpu.core.module import TpuModule, TpuDataModule
 from ray_lightning_tpu.core.trainer import Trainer
-from ray_lightning_tpu.core.callbacks import (Callback, ModelCheckpoint,
+from ray_lightning_tpu.core.callbacks import (Callback, LambdaCallback,
+                                              LearningRateMonitor,
+                                              ModelCheckpoint,
                                               EpochStatsCallback)
 from ray_lightning_tpu.core.loggers import CSVLogger, JaxProfilerCallback
 from ray_lightning_tpu.core.seed import seed_everything, reset_seed
 
 __all__ = [
-    "TpuModule", "TpuDataModule", "Trainer", "Callback", "ModelCheckpoint",
-    "EpochStatsCallback", "CSVLogger", "JaxProfilerCallback",
-    "seed_everything", "reset_seed"
+    "TpuModule", "TpuDataModule", "Trainer", "Callback", "LambdaCallback",
+    "LearningRateMonitor", "ModelCheckpoint", "EpochStatsCallback",
+    "CSVLogger", "JaxProfilerCallback", "seed_everything", "reset_seed"
 ]

@@ -244,3 +244,35 @@ class LambdaCallback(Callback):
             if not hasattr(Callback, name):
                 raise ValueError(f"Unknown callback hook {name!r}")
             setattr(self, name, fn)
+
+
+class LearningRateMonitor(Callback):
+    """Record the scheduled learning rate into ``callback_metrics``.
+
+    PTL's ``LearningRateMonitor`` analog for the optax world: requires the
+    module's ``configure_optimizers`` to return ``(tx, schedule_fn)`` (the
+    schedule is baked into ``tx``; the handle is for observability).
+    ``logging_interval``: "epoch" (default) records at each train-epoch
+    end; "step" records every batch.
+    """
+
+    def __init__(self, logging_interval: str = "epoch",
+                 key: str = "lr"):
+        if logging_interval not in ("epoch", "step"):
+            raise ValueError("logging_interval must be 'epoch' or 'step'")
+        self.logging_interval = logging_interval
+        self.key = key
+
+    def _record(self, trainer) -> None:
+        lr = trainer.current_lr
+        if lr is not None:
+            trainer.callback_metrics[self.key] = lr
+
+    def on_train_batch_end(self, trainer, pl_module, outputs, batch,
+                           batch_idx: int) -> None:
+        if self.logging_interval == "step":
+            self._record(trainer)
+
+    def on_train_epoch_end(self, trainer, pl_module) -> None:
+        if self.logging_interval == "epoch":
+            self._record(trainer)

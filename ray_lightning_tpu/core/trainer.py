@@ -225,7 +225,19 @@ class Trainer:
         return first, loader
 
     def _optimizer(self) -> optax.GradientTransformation:
-        tx = self._module.configure_optimizers()
+        out = self._module.configure_optimizers()
+        # PTL's optimizer+scheduler pairing, optax-style: the module may
+        # return (tx, schedule_fn) where schedule_fn(step) -> lr; the
+        # schedule is already baked into tx (optax composes them), the
+        # handle only feeds lr logging / LearningRateMonitor.
+        self._lr_schedule = None
+        # NB: optax.GradientTransformation IS a (Named)tuple — a bare tx
+        # is distinguished by its init/update fields, not by type
+        if isinstance(out, tuple) and not hasattr(out, "update") \
+                and len(out) == 2:
+            tx, self._lr_schedule = out
+        else:
+            tx = out
         chain = []
         if self.gradient_clip_val:
             chain.append(optax.clip_by_global_norm(self.gradient_clip_val))
@@ -234,6 +246,25 @@ class Trainer:
         if self.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(tx, self.accumulate_grad_batches)
         return tx
+
+    @property
+    def current_lr(self):
+        """Learning rate at the current global step, when the module
+        returned an ``(tx, schedule)`` pair; None otherwise."""
+        schedule = getattr(self, "_lr_schedule", None)
+        if schedule is None and self._module is not None:
+            # after a remote launch only counters/metrics sync back to the
+            # driver-side trainer; re-probe the module (pure optax
+            # construction, no devices — client-mode safe)
+            out = self._module.configure_optimizers()
+            if isinstance(out, tuple) and not hasattr(out, "update") \
+                    and len(out) == 2:
+                schedule = out[1]
+        if schedule is None:
+            return None
+        # optax.MultiSteps advances the inner schedule once per k batches
+        step = self.global_step // max(1, self.accumulate_grad_batches)
+        return float(schedule(step))
 
     def _cast_batch(self, batch: Any) -> Any:
         if not self.precision.startswith("bf16"):

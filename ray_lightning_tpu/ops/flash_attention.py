@@ -68,8 +68,8 @@ def flash_attention(q: jax.Array,
                     mask: Optional[jax.Array] = None,
                     dropout_rate: float = 0.0,
                     dropout_rng: Optional[jax.Array] = None,
-                    block_q: int = 128,
-                    block_k: int = 128,
+                    block_q: int = 512,
+                    block_k: int = 1024,
                     softmax_dtype=jnp.float32,
                     use_pallas: Optional[bool] = None) -> jax.Array:
     """Blockwise attention; signature-compatible with

@@ -63,6 +63,25 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     mb_shape = microbatches.shape[1:]
     total_ticks = M + n_stages - 1
 
+    # The scan carry circulates stage outputs, so the buffers (and the
+    # injected input) must share one dtype. Promote the input to the
+    # params' result type up front (bf16 batches through f32 params run at
+    # f32 — the bf16-mixed convention), then confirm via eval_shape.
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    compute_dtype = jnp.result_type(
+        microbatches.dtype, *[l.dtype for l in leaves]) if leaves \
+        else microbatches.dtype
+    microbatches = microbatches.astype(compute_dtype)
+    out_aval = jax.eval_shape(
+        stage_fn, stage_params,
+        jax.ShapeDtypeStruct(mb_shape, compute_dtype))
+    if out_aval.shape != mb_shape:
+        raise ValueError(
+            f"stage_fn must preserve the activation shape (pipeline "
+            f"stages chain): got {out_aval.shape} from {mb_shape}")
+    out_dtype = out_aval.dtype
+    microbatches = microbatches.astype(out_dtype)
+
     # ring: stage s sends to s+1; the wrap-around link carries no live data
     perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
 
@@ -73,7 +92,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         inject = jax.lax.dynamic_index_in_dim(
             microbatches, jnp.minimum(t, M - 1), keepdims=False)
         x = jnp.where(stage == 0, inject, recv)
-        y = stage_fn(stage_params, x)
+        y = stage_fn(stage_params, x).astype(out_dtype)
         # last stage retires microbatch t-(S-1) at ticks t >= S-1
         out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
         live = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
@@ -86,8 +105,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         recv = jax.lax.ppermute(y, axis_name, perm)
         return (recv, outputs), None
 
-    init = (jnp.zeros(mb_shape, microbatches.dtype),
-            jnp.zeros((M,) + mb_shape, microbatches.dtype))
+    init = (jnp.zeros(mb_shape, out_dtype),
+            jnp.zeros((M,) + mb_shape, out_dtype))
     (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(total_ticks))
     # only the last stage holds real outputs; one psum replicates them
     outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)

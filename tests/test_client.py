@@ -94,3 +94,18 @@ def test_client_mode_fit_never_touches_driver_devices(monkeypatch,
     assert all(
         np.isfinite(np.asarray(leaf)).all()
         for leaf in jax.tree_util.tree_leaves(params))
+
+
+def test_new_strategies_construct_without_devices(monkeypatch):
+    """Client-mode contract extends to round-2 strategies: construction and
+    the driver-side properties never touch devices."""
+    from ray_lightning_tpu import SequenceParallelStrategy
+    from ray_lightning_tpu.models.transformer import tensor_parallel_rule
+
+    _forbid_driver_devices(monkeypatch)
+    sp = SequenceParallelStrategy(dp=2, sp=4, use_tpu=True)
+    assert sp.world_size == 8
+    assert sp.distributed_sampler_kwargs == {"num_replicas": 2, "rank": 0}
+    tp = MeshStrategy(axes={"dp": 4, "tp": 2},
+                      param_rule=tensor_parallel_rule, use_tpu=True)
+    assert tp.world_size == 8

@@ -138,3 +138,70 @@ def test_stream_and_orbax_agree(tmp_root):
     for a, b in zip(jax.tree_util.tree_leaves(s1["params"]),
                     jax.tree_util.tree_leaves(s2["params"])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_lr_schedule_pairing_and_monitor(tmp_root):
+    """configure_optimizers may return (tx, schedule_fn); the schedule is
+    baked into tx and LearningRateMonitor records the decayed lr."""
+    import optax
+
+    from ray_lightning_tpu.core.callbacks import LearningRateMonitor
+    from ray_lightning_tpu.models import BoringModel
+
+    class Scheduled(BoringModel):
+        def configure_optimizers(self):
+            schedule = optax.exponential_decay(
+                init_value=1e-2, transition_steps=2, decay_rate=0.5)
+            return optax.sgd(schedule), schedule
+
+    seen = []
+
+    class Spy(LearningRateMonitor):
+        def on_train_epoch_end(self, trainer, pl_module):
+            super().on_train_epoch_end(trainer, pl_module)
+            seen.append(trainer.callback_metrics.get(self.key))
+
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=3,
+                      limit_train_batches=2, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      callbacks=[Spy()], default_root_dir=tmp_root, seed=0)
+    trainer.fit(Scheduled())
+    # epochs end at steps 2/4/6: lr halves every 2 steps from 1e-2
+    assert len(seen) == 3
+    np.testing.assert_allclose(seen, [5e-3, 2.5e-3, 1.25e-3], rtol=1e-5)
+    assert trainer.current_lr == pytest.approx(1.25e-3, rel=1e-5)
+
+
+def test_plain_optimizer_has_no_lr(tmp_root):
+    from ray_lightning_tpu.models import BoringModel
+
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=1, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(BoringModel())
+    assert trainer.current_lr is None
+
+
+def test_lr_respects_grad_accumulation(tmp_root):
+    """optax.MultiSteps advances the schedule once per k batches; the
+    reported lr must match what the optimizer actually applied."""
+    import optax
+
+    from ray_lightning_tpu.models import BoringModel
+
+    schedule = optax.exponential_decay(init_value=1e-2, transition_steps=1,
+                                       decay_rate=0.5)
+
+    class Scheduled(BoringModel):
+        def configure_optimizers(self):
+            return optax.sgd(schedule), schedule
+
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=4, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      accumulate_grad_batches=2,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(Scheduled())
+    # 4 batches / accumulate 2 = 2 optimizer steps: lr = 1e-2 * 0.5^2
+    assert trainer.current_lr == pytest.approx(2.5e-3, rel=1e-5)

@@ -130,3 +130,34 @@ def test_split_microbatches_validates():
     with pytest.raises(ValueError, match="divisible"):
         split_microbatches(jnp.zeros((10, 4)), 3)
     assert split_microbatches(jnp.zeros((12, 4)), 3).shape == (3, 4, 4)
+
+
+def test_pipeline_mixed_dtype_stage():
+    """bf16 microbatches through f32 params (the bf16-mixed pattern):
+    carries adopt the promoted output dtype instead of crashing."""
+    d = 8
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    params = _stacked_params(8, d)  # f32
+    x = jax.random.normal(jax.random.PRNGKey(6), (16, d),
+                          dtype=jnp.bfloat16)
+    mb = split_microbatches(x, 4)
+    out = _pipelined(mesh, params, mb)
+    want = _serial_reference(params, x.astype(jnp.float32))
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out.reshape(-1, d)),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_pipeline_rejects_shape_changing_stage():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    params = _stacked_params(4, 8)
+
+    def bad_stage(p, x):
+        return jnp.concatenate([x, x], axis=-1)
+
+    fn = jax.shard_map(
+        lambda p, mb: pipeline_apply(bad_stage, p, mb),
+        mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+        check_vma=False)
+    with pytest.raises(ValueError, match="preserve"):
+        jax.jit(fn)(params, jnp.zeros((4, 4, 8)))
