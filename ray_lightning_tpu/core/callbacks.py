@@ -52,6 +52,16 @@ class Callback:
     def on_test_batch_end(self, trainer, pl_module, outputs, batch,
                           batch_idx: int,
                           dataloader_idx: int = 0) -> None: ...
+    def on_predict_start(self, trainer, pl_module) -> None: ...
+    def on_predict_end(self, trainer, pl_module) -> None: ...
+    def on_predict_epoch_start(self, trainer, pl_module) -> None: ...
+    def on_predict_epoch_end(self, trainer, pl_module) -> None: ...
+    def on_predict_batch_start(self, trainer, pl_module, batch,
+                               batch_idx: int,
+                               dataloader_idx: int = 0) -> None: ...
+    def on_predict_batch_end(self, trainer, pl_module, outputs, batch,
+                             batch_idx: int,
+                             dataloader_idx: int = 0) -> None: ...
     def on_before_optimizer_step(self, trainer, pl_module,
                                  optimizer) -> None:
         """Fired once per training batch, before the compiled step.

@@ -656,13 +656,24 @@ class Trainer:
 
         n = self._resolve_limit(loader, self.limit_predict_batches)
         outs = []
+        for cb in self.callbacks:
+            cb.on_predict_start(self, module)
+            cb.on_predict_epoch_start(self, module)
         for batch_idx, batch in enumerate(loader):
             if batch_idx >= n:
                 break
+            for cb in self.callbacks:
+                cb.on_predict_batch_start(self, module, batch, batch_idx)
             batch = shardlib.put_global_batch(
                 self._cast_batch(batch), self._batch_sharding)
-            outs.append(jax.device_get(
-                predict_step(self.train_state, batch)))
+            out = jax.device_get(predict_step(self.train_state, batch))
+            outs.append(out)
+            for cb in self.callbacks:
+                cb.on_predict_batch_end(self, module, out, batch,
+                                        batch_idx)
+        for cb in self.callbacks:
+            cb.on_predict_epoch_end(self, module)
+            cb.on_predict_end(self, module)
         return WorkerOutput(
             best_model_path=None, state_stream=None,
             trainer_state=dict(epoch=self.current_epoch,

@@ -301,3 +301,20 @@ def test_sanity_metrics_discarded(tmp_root):
     # validation never ran (every 10 epochs), sanity did — its metrics
     # must not appear
     assert not any(k.startswith("val") for k in trainer.callback_metrics)
+
+
+def test_predict_hooks_fire(tmp_root):
+    rec = _HookRecorder()
+    model = LightningMNISTClassifier(
+        config={"lr": 1e-2, "batch_size": 32}, num_samples=128)
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=2, limit_val_batches=0,
+                      limit_predict_batches=2, num_sanity_val_steps=0,
+                      callbacks=[rec], enable_checkpointing=False,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(model)
+    trainer.predict(model)
+    c = rec.calls
+    assert c.index("on_predict_start") < c.index("on_predict_batch_start") \
+        < c.index("on_predict_batch_end") < c.index("on_predict_end")
+    assert c.count("on_predict_batch_start") == 2
