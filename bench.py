@@ -331,6 +331,49 @@ def _run_scaling_child(dp: int) -> dict:
     raise MeasurementError(f"scaling child dp={dp} printed no JSON")
 
 
+def _bench_flash_long_seq(T: int = 8192) -> dict:
+    """Pallas flash vs XLA fused attention, train step (fwd+bwd) at long
+    sequence — the regime the hand kernel exists for (XLA materializes the
+    scores and stops scaling ~T^2 memory)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.ops.attention import dot_product_attention
+    from ray_lightning_tpu.ops.pallas_flash import pallas_flash_attention
+
+    B, H, D = 1, 12, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q, k, v, do = (jax.random.normal(x, (B, T, H, D), dtype=jnp.bfloat16)
+                   for x in ks)
+
+    def timed(attn) -> float:
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                attn(q, k, v).astype(jnp.float32)
+                * do.astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        jax.block_until_ready(g(q, k, v))  # compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = g(q, k, v)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / 5)
+        return best
+
+    flash_s = timed(lambda q, k, v: pallas_flash_attention(
+        q, k, v, causal=True))
+    xla_s = timed(lambda q, k, v: dot_product_attention(
+        q, k, v, causal=True))
+    return {
+        "seq_len": T,
+        "flash_ms": round(flash_s * 1e3, 2),
+        "xla_dot_ms": round(xla_s * 1e3, 2),
+        "speedup": round(xla_s / flash_s, 2),
+    }
+
+
 def bench_scaling() -> dict:
     """SPMD overhead proxy on a virtual 8-device CPU mesh (weak scaling).
 
@@ -390,6 +433,12 @@ def main() -> None:
         }
     except Exception as exc:  # secondary benches degrade to a diagnostic
         extras["bert_base"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        extras["flash_attention_t8192"] = _bench_flash_long_seq()
+    except Exception as exc:
+        extras["flash_attention_t8192"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
 
     try:
         # batch scaling on the real chip: utilization growth small -> large
