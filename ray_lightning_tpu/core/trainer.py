@@ -283,11 +283,13 @@ class Trainer:
         """
         strategy = self.strategy
         mesh = strategy.mesh
-        # register the mesh for attention_impl='ring': models nest a
-        # shard_map over the sp axis inside the jitted step (no-op when
-        # the mesh has no sp axis)
+        # register the mesh for attention_impl='ring' and pipelined_stack:
+        # models nest shard_maps over the sp/pp axes inside the jitted
+        # step (no-ops when the mesh lacks those axes)
+        from ray_lightning_tpu.parallel import pipeline as _pipe
         from ray_lightning_tpu.parallel import ring_attention as _ring
         _ring.set_sp_mesh(mesh)
+        _pipe.set_pp_mesh(mesh)
         module = self._module
         model = module.configure_model()
         self._model = model

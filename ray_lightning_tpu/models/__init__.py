@@ -11,6 +11,8 @@ from ray_lightning_tpu.models.resnet import (ResNetModule, resnet18,
 from ray_lightning_tpu.models.moe import (MoeConfig, MoeModule,
                                           MoeTransformerLM,
                                           expert_parallel_rule, moe_config)
+from ray_lightning_tpu.models.pipelined_lm import (PipelinedLMModule,
+                                                   PipelinedTransformerLM)
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
@@ -18,5 +20,6 @@ __all__ = [
     "TransformerEncoder", "GPTModule", "gpt2_config", "count_params",
     "BertModule", "BertClassifier", "bert_config", "ResNetModule",
     "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
-    "expert_parallel_rule", "moe_config"
+    "expert_parallel_rule", "moe_config", "PipelinedLMModule",
+    "PipelinedTransformerLM"
 ]

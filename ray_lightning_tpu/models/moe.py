@@ -47,13 +47,8 @@ def expert_parallel_rule(path, leaf):
     """``MeshStrategy(param_rule=...)`` rule: shard the experts dimension
     of MoE weights along ``ep``; everything else replicated (compose with
     your own rule for tp/fsdp hybrids)."""
-    names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-    if any("experts" in str(n) for n in names):
-        spec = [None] * getattr(leaf, "ndim", 0)
-        if spec:
-            spec[0] = "ep"
-        return P(*spec)
-    return P()
+    from ray_lightning_tpu.parallel.sharding import leading_dim_rule
+    return leading_dim_rule("experts", "ep")(path, leaf)
 
 
 def route_top_k(probs: jax.Array, capacity: int,

@@ -5,11 +5,13 @@ from ray_lightning_tpu.parallel.sharding import (replicated, batch_sharding,
                                                  shard_pytree_along_axis,
                                                  largest_divisible_dim)
 from ray_lightning_tpu.parallel.pipeline import (pipeline_apply,
+                                                 pipeline_parallel_rule,
+                                                 pipelined_stack,
                                                  split_microbatches)
 
 __all__ = [
     "MeshSpec", "build_mesh", "DP_AXIS", "FSDP_AXIS", "TP_AXIS", "SP_AXIS",
     "PP_AXIS", "EP_AXIS", "replicated", "batch_sharding",
     "shard_pytree_along_axis", "largest_divisible_dim", "pipeline_apply",
-    "split_microbatches"
+    "pipeline_parallel_rule", "pipelined_stack", "split_microbatches"
 ]

@@ -28,12 +28,106 @@ grads + psum across dp×pp) driven this way.
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 PP_AXIS_NAME = "pp"
+
+# Mesh registered by the trainer (worker-side, at step-build time) — the
+# same pattern as ring attention's sp mesh: model code nests a shard_map
+# without threading the mesh through configs, so configs stay pure data
+# and client-mode drivers never build a mesh.
+_PP_MESH: Optional[Mesh] = None
+
+
+def set_pp_mesh(mesh: Optional[Mesh]) -> None:
+    global _PP_MESH
+    _PP_MESH = mesh
+
+
+def get_pp_mesh() -> Optional[Mesh]:
+    if _PP_MESH is not None and PP_AXIS_NAME in _PP_MESH.axis_names \
+            and _PP_MESH.shape[PP_AXIS_NAME] > 1:
+        return _PP_MESH
+    return None
+
+
+def _pipeline_parallel_rule():
+    from ray_lightning_tpu.parallel.sharding import leading_dim_rule
+    return leading_dim_rule("blocks", PP_AXIS_NAME)
+
+
+def pipeline_parallel_rule(path, leaf):
+    """``MeshStrategy(param_rule=...)`` rule: stacked layer params (leading
+    layers dim, path containing ``blocks``) shard over ``pp``; embeddings /
+    head / norms replicate. Pairs with :func:`pipelined_stack`."""
+    return _pipeline_parallel_rule()(path, leaf)
+
+
+def pipelined_stack(layer_fn: Callable[[Any, jax.Array], jax.Array],
+                    stacked_params: Any,
+                    x: jax.Array,
+                    *,
+                    n_microbatches: Optional[int] = None) -> jax.Array:
+    """Apply a stacked layer sequence, pipelined over a registered pp mesh.
+
+    ``stacked_params`` leaves have a leading layers dim; ``layer_fn(p, x)``
+    applies ONE layer. Without a registered pp mesh (or a too-small batch)
+    this is a plain serial ``lax.scan`` — models can call it
+    unconditionally, exactly like ring attention's sp entry point. With a
+    mesh, layers shard over ``pp`` (use :func:`pipeline_parallel_rule` so
+    the params already live there), the batch dim splits over the mesh's
+    data axes, and each data group runs the GPipe schedule.
+    """
+    def serial(params, x):
+        def body(x, p):
+            return layer_fn(p, x), None
+        out, _ = jax.lax.scan(body, x, params)
+        return out
+
+    mesh = get_pp_mesh()
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if mesh is None:
+        return serial(stacked_params, x)
+    S = mesh.shape[PP_AXIS_NAME]
+    if n_layers % S != 0:
+        return serial(stacked_params, x)
+    from ray_lightning_tpu.parallel.sharding import data_axis_names
+    data_axes = data_axis_names(mesh)
+    data_size = 1
+    for a in data_axes:
+        data_size *= mesh.shape[a]
+    B = x.shape[0]
+    if n_microbatches is not None:
+        M = n_microbatches
+        if B % (data_size * M) != 0:
+            # an explicit request that cannot be honored is a
+            # misconfiguration — surface it, never silently reschedule
+            raise ValueError(
+                f"batch size {B} is not divisible by data_size "
+                f"{data_size} x n_microbatches {M}; adjust the batch or "
+                "the microbatch count")
+    else:
+        M = 2 * S
+        if B % (data_size * M) != 0:
+            M = max(1, B // data_size)
+            if B % (data_size * M) != 0:
+                return serial(stacked_params, x)
+
+    def local(params, xb):
+        mb = split_microbatches(xb, M)
+        out = pipeline_apply(lambda p, z: serial(p, z), params, mb)
+        return out.reshape(xb.shape)
+
+    spec_x = P(data_axes if data_axes else None)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(PP_AXIS_NAME), spec_x), out_specs=spec_x,
+        check_vma=False)
+    return fn(stacked_params, x)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],

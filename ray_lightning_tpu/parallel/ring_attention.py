@@ -78,7 +78,8 @@ def sp_sharded_attention(q: jax.Array,
             "dropout=0.0 / drop the mask, or use attention_impl='dot'.")
     if q.shape[1] % mesh.shape[SP_AXIS_NAME] != 0:
         return ring_attention(q, k, v, causal=causal)
-    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    from ray_lightning_tpu.parallel.sharding import data_axis_names
+    data_axes = data_axis_names(mesh)
     data_size = 1
     for a in data_axes:
         data_size *= mesh.shape[a]

@@ -23,6 +23,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def data_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axes that carry batch-dim sharding (single source of
+    truth for batch_sharding / pipelined_stack / sp_sharded_attention)."""
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def leading_dim_rule(keyword: str, axis: str):
+    """Build a ``(path, leaf) -> PartitionSpec`` rule sharding the leading
+    dim of every param whose path contains ``keyword`` along ``axis`` —
+    the shared shape of expert-parallel ('experts' → 'ep') and
+    pipeline-parallel ('blocks' → 'pp') layouts."""
+    def rule(path, leaf):
+        names = [str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path]
+        if any(keyword in n for n in names):
+            spec = [None] * getattr(leaf, "ndim", 0)
+            if spec:
+                spec[0] = axis
+            return P(*spec)
+        return P()
+    return rule
+
+
 def batch_sharding(mesh: Mesh,
                    data_axes: Optional[Sequence[str]] = None) -> NamedSharding:
     """Shard the leading (batch) dim across the data axes of the mesh.
@@ -33,7 +56,7 @@ def batch_sharding(mesh: Mesh,
     ``dp``×``fsdp`` (and any other data-like axes present).
     """
     if data_axes is None:
-        data_axes = [a for a in ("dp", "fsdp") if a in mesh.axis_names]
+        data_axes = data_axis_names(mesh)
     axes = tuple(a for a in data_axes if a in mesh.axis_names)
     if not axes:
         return replicated(mesh)

@@ -333,11 +333,13 @@ class Strategy:
 
     def teardown(self) -> None:
         self._mesh = None
-        # drop the trainer-registered ring-attention mesh so later
+        # drop the trainer-registered ring/pipeline meshes so later
         # model.apply calls outside a trainer run locally, not in a
         # shard_map over a dead run's devices
+        from ray_lightning_tpu.parallel import pipeline as _pipe
         from ray_lightning_tpu.parallel import ring_attention as _ring
         _ring.set_sp_mesh(None)
+        _pipe.set_pp_mesh(None)
 
     def __repr__(self) -> str:
         return (f"{type(self).__name__}(num_workers={self.num_workers}, "
