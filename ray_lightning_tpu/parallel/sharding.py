@@ -123,6 +123,31 @@ def apply_rule(tree: Any, mesh: Mesh,
     return jax.tree_util.tree_map_with_path(_leaf, tree)
 
 
+def put_host_local_batch(local_batch: Any, sharding: Any) -> Any:
+    """Assemble a global array from per-process host-LOCAL batch shards.
+
+    The memory-lean alternative to :func:`put_global_batch` for multi-host
+    jobs: each process loads only its own slice of the global batch (use
+    ``strategy.distributed_sampler_kwargs`` to shard the loader — rank r
+    of n replicas loads samples ``r, r+n, …`` or the r-th contiguous
+    block, matching the batch sharding's dp layout), and
+    ``jax.make_array_from_process_local_data`` stitches the global array
+    without any host ever materializing the full batch. Single-process:
+    plain ``device_put``. ``sharding`` may be one sharding or a pytree.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(local_batch, sharding)
+    is_tree = not isinstance(sharding, jax.sharding.Sharding)
+
+    def _leaf(x, s):
+        return jax.make_array_from_process_local_data(s, np.asarray(x))
+
+    if is_tree:
+        return jax.tree_util.tree_map(_leaf, local_batch, sharding)
+    return jax.tree_util.tree_map(lambda x: _leaf(x, sharding),
+                                  local_batch)
+
+
 def put_global_batch(batch: Any, sharding: Any) -> Any:
     """Place a host-global batch onto a (possibly multi-process) mesh.
 

@@ -274,3 +274,57 @@ def test_two_process_tensor_parallel(tmp_path):
     finally:
         ray_mod.shutdown()
     assert trainer.global_step == 2
+
+
+def _host_local_feed_worker(global_seed: int, batch: int, dim: int):
+    """Runs in each worker process: rendezvous via the launcher-broadcast
+    TL_* env, load ONLY this rank's contiguous shard, assemble globally."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu import RayStrategy
+    from ray_lightning_tpu.parallel import sharding as shardlib
+
+    strategy = RayStrategy(num_workers=2)
+    strategy.set_remote(True)
+    strategy.worker_setup(process_idx=int(
+        __import__("os").environ["TL_RANK"]))
+    rank = jax.process_index()
+
+    rng = np.random.default_rng(global_seed)
+    full = rng.normal(size=(batch, dim)).astype(np.float32)
+    local = full[rank * batch // 2:(rank + 1) * batch // 2]  # my shard only
+
+    sharding = strategy.batch_sharding()
+    arr = shardlib.put_host_local_batch(local, sharding)
+    total = jax.jit(jnp.sum, out_shardings=strategy.scalar_sharding())(arr)
+    return float(total), float(full.sum())
+
+
+@pytest.mark.multiproc
+def test_host_local_batch_feeding_two_processes(tmp_path):
+    """Memory-lean multi-host input: each process loads only its own
+    sampler shard; the assembled global array reduces to the same value
+    as the host-global batch (no host ever held the full batch)."""
+    ray_mod = _make_backend()
+    ray_mod.init()
+    try:
+        from ray_lightning_tpu.launchers.ray_launcher import RayLauncher
+        from ray_lightning_tpu import RayStrategy
+
+        strategy = RayStrategy(num_workers=2)
+        launcher = RayLauncher(strategy, ray_module=ray_mod)
+        launcher.setup_workers(tune_enabled=False)
+        for rank, w in enumerate(launcher._workers):
+            ray_mod.get(w.set_env_var.remote("TL_RANK", str(rank)))
+        futures = [
+            w.execute.remote(_host_local_feed_worker, 7, 16, 8)
+            for w in launcher._workers
+        ]
+        results = ray_mod.get(futures)
+        launcher.teardown_workers()
+    finally:
+        ray_mod.shutdown()
+    for got, want in results:
+        np.testing.assert_allclose(got, want, rtol=1e-5)
