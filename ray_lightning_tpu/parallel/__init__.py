@@ -2,6 +2,7 @@ from ray_lightning_tpu.parallel.mesh import (MeshSpec, build_mesh,
                                              DP_AXIS, FSDP_AXIS, TP_AXIS,
                                              SP_AXIS, PP_AXIS, EP_AXIS)
 from ray_lightning_tpu.parallel.sharding import (replicated, batch_sharding,
+                                                 compose_rules,
                                                  shard_pytree_along_axis,
                                                  largest_divisible_dim,
                                                  put_global_batch,
@@ -14,7 +15,8 @@ from ray_lightning_tpu.parallel.pipeline import (pipeline_apply,
 __all__ = [
     "MeshSpec", "build_mesh", "DP_AXIS", "FSDP_AXIS", "TP_AXIS", "SP_AXIS",
     "PP_AXIS", "EP_AXIS", "replicated", "batch_sharding",
-    "shard_pytree_along_axis", "largest_divisible_dim", "put_global_batch",
+    "compose_rules", "shard_pytree_along_axis", "largest_divisible_dim",
+    "put_global_batch",
     "put_host_local_batch", "pipeline_apply", "pipeline_parallel_rule",
     "pipelined_stack", "split_microbatches"
 ]

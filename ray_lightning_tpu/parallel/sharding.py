@@ -29,6 +29,25 @@ def data_axis_names(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
 
 
+def compose_rules(*rules):
+    """Combine ``(path, leaf) -> PartitionSpec`` rules: the first rule
+    returning a non-trivial spec wins, so e.g. MoE expert banks take the
+    ``ep`` layout while the attention blocks around them take the
+    Megatron ``tp`` layout::
+
+        MeshStrategy(axes={"dp": 2, "ep": 2, "tp": 2},
+                     param_rule=compose_rules(expert_parallel_rule,
+                                              tensor_parallel_rule))
+    """
+    def rule(path, leaf):
+        for r in rules:
+            spec = r(path, leaf)
+            if any(s is not None for s in spec):
+                return spec
+        return P()
+    return rule
+
+
 def leading_dim_rule(keyword: str, axis: str):
     """Build a ``(path, leaf) -> PartitionSpec`` rule sharding the leading
     dim of every param whose path contains ``keyword`` along ``axis`` —

@@ -128,3 +128,35 @@ def test_expert_parallel_sharding(tmp_root):
             replicated += 1
     assert ep_sharded >= 8   # up/down kernels+biases × 2 layers
     assert replicated > 0
+
+
+def test_moe_composes_with_tensor_parallelism(tmp_root):
+    """ep + tp in one layout via compose_rules: expert banks shard over
+    ep, attention blocks take the Megatron tp layout, training runs."""
+    from ray_lightning_tpu.models.transformer import tensor_parallel_rule
+    from ray_lightning_tpu.parallel import compose_rules
+
+    strategy = MeshStrategy(
+        axes={"dp": 2, "ep": 2, "tp": 2},
+        param_rule=compose_rules(expert_parallel_rule,
+                                 tensor_parallel_rule))
+    model = MoeModule(size="nano", batch_size=8, seq_len=32,
+                      num_samples=32, vocab_size=128)
+    trainer = Trainer(strategy=strategy, max_epochs=1,
+                      limit_train_batches=2, limit_val_batches=0,
+                      enable_checkpointing=False, num_sanity_val_steps=0,
+                      default_root_dir=tmp_root, seed=0)
+    trainer.fit(model)
+    flat = jax.tree_util.tree_flatten_with_path(
+        trainer.train_state.params)[0]
+    ep_hits = tp_hits = 0
+    for path, leaf in flat:
+        names = "/".join(str(getattr(p, "key", p)) for p in path)
+        spec = leaf.sharding.spec
+        if "experts" in names:
+            assert spec[0] == "ep", (names, spec)
+            ep_hits += 1
+        elif "qkv" in names and names.endswith("kernel"):
+            assert spec[-2] == "tp", (names, spec)
+            tp_hits += 1
+    assert ep_hits >= 4 and tp_hits >= 2
