@@ -19,7 +19,8 @@ from ray_lightning_tpu.strategies import (RayStrategy, DataParallelStrategy,
                                           MeshStrategy,
                                           SequenceParallelStrategy)
 from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
-                                    Callback, ModelCheckpoint,
+                                    Callback, EarlyStopping,
+                                    EMAWeightAveraging, ModelCheckpoint,
                                     EpochStatsCallback, seed_everything)
 from ray_lightning_tpu.launchers import RayLauncher, LocalLauncher
 
@@ -30,6 +31,7 @@ __all__ = [
     "ZeroOneStrategy", "HorovodRayStrategy", "AllReduceStrategy",
     "FSDPStrategy", "MeshStrategy", "SequenceParallelStrategy", "Trainer",
     "TpuModule", "TpuDataModule",
-    "Callback", "ModelCheckpoint", "EpochStatsCallback", "seed_everything",
+    "Callback", "EarlyStopping", "EMAWeightAveraging", "ModelCheckpoint",
+    "EpochStatsCallback", "seed_everything",
     "RayLauncher", "LocalLauncher"
 ]

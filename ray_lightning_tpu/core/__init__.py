@@ -1,6 +1,8 @@
 from ray_lightning_tpu.core.module import TpuModule, TpuDataModule
 from ray_lightning_tpu.core.trainer import Trainer
-from ray_lightning_tpu.core.callbacks import (Callback, LambdaCallback,
+from ray_lightning_tpu.core.callbacks import (Callback, EarlyStopping,
+                                              EMAWeightAveraging,
+                                              LambdaCallback,
                                               LearningRateMonitor,
                                               ModelCheckpoint,
                                               EpochStatsCallback)
@@ -8,7 +10,8 @@ from ray_lightning_tpu.core.loggers import CSVLogger, JaxProfilerCallback
 from ray_lightning_tpu.core.seed import seed_everything, reset_seed
 
 __all__ = [
-    "TpuModule", "TpuDataModule", "Trainer", "Callback", "LambdaCallback",
+    "TpuModule", "TpuDataModule", "Trainer", "Callback", "EarlyStopping",
+    "EMAWeightAveraging", "LambdaCallback",
     "LearningRateMonitor", "ModelCheckpoint", "EpochStatsCallback",
     "CSVLogger", "JaxProfilerCallback", "seed_everything", "reset_seed"
 ]

@@ -81,6 +81,15 @@ class Callback:
     def state_dict(self) -> Dict[str, Any]:
         return {}
     def load_state_dict(self, state: Dict[str, Any]) -> None: ...
+    def sharded_state(self) -> Optional[Any]:
+        """Optional pytree of ``jax.Array`` leaves to persist with the
+        checkpoint. Unlike ``state_dict`` (host scalars, msgpack-encoded),
+        this travels the same path as the train state: consolidated for
+        the stream format, written shard-by-shard for orbax — so device
+        trees (e.g. an EMA of sharded params) checkpoint without a host
+        gather."""
+        return None
+    def load_sharded_state(self, tree: Any) -> None: ...
 
 
 class ModelCheckpoint(Callback):
@@ -98,13 +107,16 @@ class ModelCheckpoint(Callback):
                  mode: str = "min",
                  save_top_k: int = 1,
                  save_last: bool = False,
-                 save_format: str = "stream"):
+                 save_format: str = "stream",
+                 async_save: bool = False):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
         if save_format not in ("stream", "orbax"):
             raise ValueError(
                 f"save_format must be 'stream' or 'orbax', got "
                 f"{save_format!r}")
+        if async_save and save_format != "orbax":
+            raise ValueError("async_save requires save_format='orbax'")
         self.dirpath = dirpath
         self.filename = filename
         self.monitor = monitor
@@ -112,10 +124,12 @@ class ModelCheckpoint(Callback):
         self.save_top_k = save_top_k
         self.save_last = save_last
         self.save_format = save_format
+        self.async_save = async_save
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self.last_model_path: str = ""
         self._saved: list = []  # (score, path), worst-first
+        self._last_saved_path: str = ""
 
     def setup(self, trainer, pl_module, stage: str) -> None:
         if self.dirpath is None:
@@ -162,11 +176,14 @@ class ModelCheckpoint(Callback):
             os.makedirs(self.dirpath, exist_ok=True)
         suffix = ".ckpt" if self.save_format == "stream" else ".orbax"
         path = os.path.join(self.dirpath, name + suffix)
-        trainer.save_checkpoint(path, save_format=self.save_format)
+        trainer.save_checkpoint(path, save_format=self.save_format,
+                                async_save=self.async_save)
+        self._last_saved_path = path
         if self.save_last:
             last_path = os.path.join(self.dirpath, "last" + suffix)
             trainer.save_checkpoint(last_path,
-                                    save_format=self.save_format)
+                                    save_format=self.save_format,
+                                    async_save=self.async_save)
         if trainer.global_rank != 0:
             return
         # bookkeeping + pruning stay rank-0-only
@@ -190,7 +207,16 @@ class ModelCheckpoint(Callback):
             _score, path = self._saved.pop()
             if path != self.best_model_path and os.path.exists(path):
                 if os.path.isdir(path):  # orbax checkpoints are directories
+                    # directories from *previous* epochs are already
+                    # committed (AsyncCheckpointer serializes saves), but
+                    # the save issued THIS call can itself be the worst
+                    # and get pruned immediately — wait for that one case
+                    # instead of serializing every epoch
                     import shutil
+                    if self.async_save and path == self._last_saved_path:
+                        from ray_lightning_tpu.core.checkpoint import \
+                            wait_for_async_saves
+                        wait_for_async_saves()
                     shutil.rmtree(path, ignore_errors=True)
                 else:
                     os.remove(path)
@@ -206,6 +232,92 @@ class ModelCheckpoint(Callback):
         self.best_model_path = state.get("best_model_path", "")
         self.best_model_score = state.get("best_model_score")
         self.last_model_path = state.get("last_model_path", "")
+
+
+class EarlyStopping(Callback):
+    """Stop training when a monitored metric stops improving.
+
+    Parity target: PTL's ``EarlyStopping`` as exercised through the
+    reference's launcher (``tests/test_ddp.py:289-308`` — patience-driven
+    stop on ``val_loss`` inside a Ray worker). Runs identically on every
+    rank: the monitored metric comes from replicated ``callback_metrics``,
+    so all SPMD processes reach the same stop decision with no collective.
+    """
+
+    def __init__(self,
+                 monitor: str = "val_loss",
+                 min_delta: float = 0.0,
+                 patience: int = 3,
+                 mode: str = "min",
+                 check_on_train_epoch_end: bool = False,
+                 verbose: bool = False,
+                 strict: bool = True):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        self.patience = patience
+        self.mode = mode
+        self.check_on_train_epoch_end = check_on_train_epoch_end
+        self.verbose = verbose
+        self.strict = strict
+        self.wait_count = 0
+        self.stopped_epoch = 0
+        self.best_score: Optional[float] = None
+
+    def _improved(self, score: float) -> bool:
+        if self.best_score is None:
+            return True
+        if self.mode == "min":
+            return score < self.best_score - self.min_delta
+        return score > self.best_score + self.min_delta
+
+    def _run_check(self, trainer) -> None:
+        if trainer.sanity_checking:
+            return
+        raw = trainer.callback_metrics.get(self.monitor)
+        if raw is None:
+            if self.strict:
+                raise RuntimeError(
+                    f"EarlyStopping: monitored metric {self.monitor!r} not "
+                    f"found in callback_metrics "
+                    f"({sorted(trainer.callback_metrics)}); pass strict="
+                    "False to skip epochs where it is absent.")
+            return
+        score = float(np.asarray(raw))
+        if self._improved(score):
+            self.best_score = score
+            self.wait_count = 0
+            return
+        self.wait_count += 1
+        if self.wait_count >= self.patience:
+            trainer.should_stop = True
+            self.stopped_epoch = trainer.current_epoch
+            if self.verbose and trainer.global_rank == 0:
+                print(f"EarlyStopping: {self.monitor} did not improve for "
+                      f"{self.wait_count} checks (best "
+                      f"{self.best_score:.6f}); stopping at epoch "
+                      f"{self.stopped_epoch}.")
+
+    def on_validation_end(self, trainer, pl_module) -> None:
+        if not self.check_on_train_epoch_end:
+            self._run_check(trainer)
+
+    def on_train_epoch_end(self, trainer, pl_module) -> None:
+        if self.check_on_train_epoch_end:
+            self._run_check(trainer)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "wait_count": self.wait_count,
+            "stopped_epoch": self.stopped_epoch,
+            "best_score": self.best_score,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.wait_count = state.get("wait_count", 0)
+        self.stopped_epoch = state.get("stopped_epoch", 0)
+        self.best_score = state.get("best_score")
 
 
 class EpochStatsCallback(Callback):
@@ -244,6 +356,100 @@ class EpochStatsCallback(Callback):
         if self.print_stats and trainer.global_rank == 0:
             print(f"Epoch {trainer.current_epoch}: {dt:.2f}s, "
                   f"avg peak HBM {peak:.0f} MiB")
+
+
+class EMAWeightAveraging(Callback):
+    """Maintain an exponential moving average of the parameters on-device.
+
+    TPU-native take on PTL's ``StochasticWeightAveraging``: the average is
+    updated by a jitted elementwise merge that inherits the params'
+    shardings (EMA shards live beside the param shards — no host copy, no
+    gather), so it composes with DP/ZeRO/FSDP meshes unchanged.
+
+    ``swap_validation=True`` runs every validation/test epoch with the
+    averaged weights (swapped in before the eval loop, restored after) —
+    monitored metrics and early stopping then see the EMA model. The raw
+    weights are restored before ``ModelCheckpoint`` saves; checkpoints
+    always carry BOTH trees (raw params in the train state, the EMA
+    average in this callback's sharded state), so either model can be
+    exported after resume.
+    """
+
+    def __init__(self, decay: float = 0.999, update_every: int = 1,
+                 swap_validation: bool = False):
+        if not 0.0 < decay < 1.0:
+            raise ValueError(f"decay must be in (0, 1), got {decay}")
+        self.decay = decay
+        self.update_every = max(1, int(update_every))
+        self.swap_validation = swap_validation
+        self.ema_params = None
+        self._stashed = None
+        self._update = None
+
+    def on_train_start(self, trainer, pl_module) -> None:
+        if self.ema_params is None:
+            # start from a true COPY of the current params (restored EMA
+            # arrives via load_state_dict before this hook): the train
+            # step donates its input state, so aliasing the live buffers
+            # would leave the EMA pointing at deleted memory
+            import jax.numpy as jnp
+            self.ema_params = jax.tree_util.tree_map(
+                jnp.copy, trainer.train_state.params)
+        else:  # resumed: host numpy → device, following the live sharding
+            self.ema_params = jax.tree_util.tree_map(
+                lambda host, live: jax.device_put(host, live.sharding),
+                self.ema_params, trainer.train_state.params)
+        decay = self.decay
+
+        @jax.jit
+        def update(ema, params):
+            return jax.tree_util.tree_map(
+                lambda e, p: decay * e + (1.0 - decay) * p, ema, params)
+
+        self._update = update
+
+    def on_train_batch_end(self, trainer, pl_module, outputs, batch,
+                           batch_idx: int) -> None:
+        if trainer.global_step % self.update_every == 0:
+            self.ema_params = self._update(self.ema_params,
+                                           trainer.train_state.params)
+
+    # -- swap the averaged weights in for evaluation ------------------- #
+    def _swap_in(self, trainer) -> None:
+        if self.swap_validation and self.ema_params is not None \
+                and self._stashed is None:
+            self._stashed = trainer.train_state.params
+            trainer.train_state = trainer.train_state.replace(
+                params=self.ema_params)
+
+    def _swap_out(self, trainer) -> None:
+        if self._stashed is not None:
+            trainer.train_state = trainer.train_state.replace(
+                params=self._stashed)
+            self._stashed = None
+
+    def on_validation_start(self, trainer, pl_module) -> None:
+        self._swap_in(trainer)
+
+    def on_validation_end(self, trainer, pl_module) -> None:
+        self._swap_out(trainer)
+
+    def on_test_start(self, trainer, pl_module) -> None:
+        self._swap_in(trainer)
+
+    def on_test_end(self, trainer, pl_module) -> None:
+        self._swap_out(trainer)
+
+    def sharded_state(self) -> Optional[Any]:
+        # the EMA tree rides the train-state path (shard-by-shard under
+        # orbax) — NEVER through the msgpack meta, which would host-gather
+        # shards that multi-host processes can't even address
+        return self.ema_params
+
+    def load_sharded_state(self, tree: Any) -> None:
+        # host numpy (stream/orbax restore) — re-placed onto the live
+        # sharding by on_train_start
+        self.ema_params = tree
 
 
 class LambdaCallback(Callback):

@@ -27,26 +27,74 @@ from flax import serialization
 
 _META_NAME = "tl_meta.msgpack"
 _STATE_NAME = "state"
+_CB_NAME = "cb_arrays"
+
+# process-wide async checkpointer: orbax requires one long-lived instance
+# (it owns the background commit thread + multihost barrier ids)
+_ASYNC_CKPTR = None
+
+
+def _async_checkpointer():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        import orbax.checkpoint as ocp
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
+def wait_for_async_saves() -> None:
+    """Block until every in-flight async checkpoint commit finishes.
+
+    No-op when no async save was ever issued. The trainer calls this at
+    fit end (and before reading a checkpoint) so a process never exits —
+    or restores — with a half-committed directory.
+    """
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
 
 
 def save_sharded_checkpoint(dirpath: str, ckpt: Dict[str, Any],
-                            train_state: Any) -> None:
+                            train_state: Any,
+                            async_save: bool = False) -> None:
     """Write ``ckpt`` (minus the state) + the *sharded* train state.
 
     ``train_state`` leaves stay ``jax.Array``s — orbax writes each shard
     from the process that owns it (multi-host safe), so no host gather and
     no 2× host-RAM spike like the stream format.
+
+    ``async_save=True`` returns as soon as the device→host copy is done;
+    the disk write commits on a background thread (training overlaps the
+    I/O). Call :func:`wait_for_async_saves` before relying on the files.
     """
     import orbax.checkpoint as ocp
 
     dirpath = os.path.abspath(dirpath)
     os.makedirs(dirpath, exist_ok=True)
     state_dict = serialization.to_state_dict(train_state)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(dirpath, _STATE_NAME), state_dict, force=True)
-    ckptr.wait_until_finished()
+    # callback device trees (e.g. EMA params) are saved as a sibling orbax
+    # item — shard-by-shard like the state, never through the msgpack meta
+    # (whose host-gather would crash on non-addressable multi-host shards)
+    cb_arrays = ckpt.get("callback_arrays") or None
+    if async_save:
+        ckptr = _async_checkpointer()
+        ckptr.save(os.path.join(dirpath, _STATE_NAME),
+                   args=ocp.args.StandardSave(state_dict), force=True)
+        if cb_arrays:  # serializes behind the state save; still async
+            ckptr.save(os.path.join(dirpath, _CB_NAME),
+                       args=ocp.args.StandardSave(
+                           serialization.to_state_dict(cb_arrays)),
+                       force=True)
+    else:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(dirpath, _STATE_NAME), state_dict,
+                   force=True)
+        if cb_arrays:
+            ckptr.save(os.path.join(dirpath, _CB_NAME),
+                       serialization.to_state_dict(cb_arrays), force=True)
+        ckptr.wait_until_finished()
 
-    meta = {k: v for k, v in ckpt.items() if k != "state"}
+    meta = {k: v for k, v in ckpt.items()
+            if k not in ("state", "callback_arrays")}
     with open(os.path.join(dirpath, _META_NAME), "wb") as f:
         f.write(serialization.msgpack_serialize(meta))
 
@@ -66,19 +114,32 @@ def load_sharded_checkpoint(dirpath: str,
     dirpath = os.path.abspath(dirpath)
     ckptr = ocp.StandardCheckpointer()
     state_path = os.path.join(dirpath, _STATE_NAME)
-    if target is not None:
-        state = ckptr.restore(state_path, target)
-    else:
+    if not os.path.isdir(state_path):
+        # orbax commits the item atomically (tmp dir + rename), so a
+        # missing 'state' item means the save never finished — e.g. an
+        # async commit interrupted by OOM/preemption. The meta file alone
+        # does not make a checkpoint.
+        raise FileNotFoundError(
+            f"{dirpath} has no committed '{_STATE_NAME}' item — the "
+            "checkpoint is incomplete (the saving process likely died "
+            "before its orbax commit finished). Pick an older checkpoint.")
+
+    def _restore_numpy(path):
         # Restore to host numpy EXPLICITLY: a bare restore replays the
         # saving run's device layout, which fails whenever the resuming
         # world differs (e.g. a 2-process save resumed single-process —
         # the worker-count-resize path this format exists for).
-        state_meta = ckptr.metadata(state_path)
-        meta_tree = getattr(state_meta, "item_metadata", state_meta)
+        item_meta = ckptr.metadata(path)
+        meta_tree = getattr(item_meta, "item_metadata", item_meta)
         restore_args = jax.tree_util.tree_map(
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta_tree)
-        state = ocp.PyTreeCheckpointer().restore(state_path,
-                                                 restore_args=restore_args)
+        return ocp.PyTreeCheckpointer().restore(path,
+                                                restore_args=restore_args)
+
+    if target is not None:
+        state = ckptr.restore(state_path, target)
+    else:
+        state = _restore_numpy(state_path)
     meta_path = os.path.join(dirpath, _META_NAME)
     meta: Dict[str, Any] = {}
     if os.path.exists(meta_path):
@@ -86,6 +147,9 @@ def load_sharded_checkpoint(dirpath: str,
             meta = serialization.msgpack_restore(f.read())
     out = dict(meta)
     out["state"] = state
+    cb_path = os.path.join(dirpath, _CB_NAME)
+    if os.path.isdir(cb_path):
+        out["callback_arrays"] = _restore_numpy(cb_path)
     return out
 
 

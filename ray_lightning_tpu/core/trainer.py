@@ -102,6 +102,7 @@ class Trainer:
         self.callback_metrics: Dict[str, Any] = {}
         self.logged_metrics: Dict[str, Any] = {}
         self.sanity_checking = False
+        self.should_stop = False  # settable by callbacks (EarlyStopping)
         self.state = "idle"
         self.train_state: Optional[TrainState] = None
 
@@ -366,6 +367,7 @@ class Trainer:
                     datamodule: Optional[TpuDataModule],
                     ckpt_path: Optional[str]) -> WorkerOutput:
         self._attach(module, datamodule)
+        self.should_stop = False
         module.prepare_data()
         if datamodule is not None:
             datamodule.prepare_data()
@@ -393,6 +395,10 @@ class Trainer:
                     type(cb).__name__)
                 if cb_state:
                     cb.load_state_dict(cb_state)
+                cb_tree = restored_ckpt.get("callback_arrays", {}).get(
+                    type(cb).__name__)
+                if cb_tree is not None:
+                    cb.load_sharded_state(cb_tree)
             module.on_load_checkpoint(restored_ckpt.get("module", {}))
 
         module.on_fit_start()
@@ -451,6 +457,8 @@ class Trainer:
                 if 0 <= self.max_steps <= self.global_step:
                     stop = True
                     break
+                if self.should_stop:  # PTL parity: honored mid-epoch too
+                    break
 
             # epoch aggregation: one host sync per epoch, not per step
             agg = self._aggregate_epoch_logs(epoch_logs, prefix="train_")
@@ -471,7 +479,7 @@ class Trainer:
             module.on_train_epoch_end()
             for cb in self.callbacks:
                 cb.on_train_epoch_end(self, module)
-            if stop:
+            if stop or self.should_stop:
                 break
 
         module.on_train_end()
@@ -484,6 +492,8 @@ class Trainer:
         for cb in self.callbacks:
             cb.teardown(self, module, "fit")
 
+        from ray_lightning_tpu.core.checkpoint import wait_for_async_saves
+        wait_for_async_saves()
         return self._collect_rank_zero_results()
 
     def _run_validation(self, val_loader, module, limit=None):
@@ -734,7 +744,8 @@ class Trainer:
             best_model_path=best_path,
             state_stream=stream,
             trainer_state=dict(epoch=self.current_epoch,
-                               global_step=self.global_step),
+                               global_step=self.global_step,
+                               should_stop=self.should_stop),
             callback_metrics=_util.tensor_metrics_to_numpy(
                 self.callback_metrics),
             logged_metrics=_util.tensor_metrics_to_numpy(
@@ -754,6 +765,8 @@ class Trainer:
             "epoch", self.current_epoch)
         self.global_step = output.trainer_state.get(
             "global_step", self.global_step)
+        self.should_stop = output.trainer_state.get(
+            "should_stop", self.should_stop)
         self.callback_metrics.update(
             _util.numpy_metrics_to_device(output.callback_metrics))
         self.logged_metrics.update(
@@ -778,19 +791,28 @@ class Trainer:
                     cb.load_state_dict(st)
 
     def save_checkpoint(self, filepath: str,
-                        save_format: str = "stream") -> None:
+                        save_format: str = "stream",
+                        async_save: bool = False) -> None:
         """Dump a full resumable checkpoint.
 
         ``save_format="stream"``: reference-parity byte-stream file
         (consolidates to host — rank-0 only). ``save_format="orbax"``:
         sharded directory checkpoint, every host writes its own shards —
-        see :mod:`ray_lightning_tpu.core.checkpoint`.
+        see :mod:`ray_lightning_tpu.core.checkpoint`. ``async_save``
+        (orbax only) overlaps the disk commit with training; the trainer
+        waits for in-flight commits at fit end.
         """
+        if async_save and save_format != "orbax":
+            raise ValueError(
+                "async_save requires save_format='orbax' (the stream "
+                "format is a rank-0 host consolidation; there is no "
+                "device-side copy to overlap)")
         if save_format == "orbax":
             from ray_lightning_tpu.core.checkpoint import \
                 save_sharded_checkpoint
             ckpt = self.dump_checkpoint(consolidate=False)
-            save_sharded_checkpoint(filepath, ckpt, self.train_state)
+            save_sharded_checkpoint(filepath, ckpt, self.train_state,
+                                    async_save=async_save)
             return
         ckpt = self.dump_checkpoint()
         os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
@@ -813,13 +835,26 @@ class Trainer:
             },
             "module": module_state,
         }
+        # device trees contributed by callbacks (e.g. EMA params) ride the
+        # train-state path: consolidated to host for the stream format,
+        # left as live shards for orbax (each process writes its own)
+        cb_arrays = {}
+        for cb in self.callbacks:
+            tree = cb.sharded_state()
+            if tree is not None:
+                cb_arrays[type(cb).__name__] = (
+                    jax.device_get(tree) if consolidate else tree)
+        if cb_arrays:
+            ckpt["callback_arrays"] = cb_arrays
         for cb in self.callbacks:
             cb.on_save_checkpoint(self, self._module, ckpt)
         return ckpt
 
     def _read_checkpoint(self, path: str) -> Dict[str, Any]:
         from ray_lightning_tpu.core.checkpoint import (
-            is_sharded_checkpoint, load_sharded_checkpoint)
+            is_sharded_checkpoint, load_sharded_checkpoint,
+            wait_for_async_saves)
+        wait_for_async_saves()  # never restore a half-committed directory
         if is_sharded_checkpoint(path):
             ckpt = load_sharded_checkpoint(path)
         else:

@@ -318,3 +318,53 @@ def test_predict_hooks_fire(tmp_root):
     assert c.index("on_predict_start") < c.index("on_predict_batch_start") \
         < c.index("on_predict_batch_end") < c.index("on_predict_end")
     assert c.count("on_predict_batch_start") == 2
+
+
+def test_early_stop(tmp_root):
+    """EarlyStopping through the launched fit stops after `patience`
+    non-improving validation epochs. Parity: tests/test_ddp.py:289-308."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu import EarlyStopping
+    from ray_lightning_tpu.core.callbacks import LambdaCallback
+
+    class PlateauModel(BoringModel):
+        def validation_step(self, model, variables, batch, rng):
+            return {"val_loss": jnp.float32(1.0)}  # never improves
+
+    val_epochs = []
+    probe = LambdaCallback(
+        on_validation_end=lambda tr, m: val_epochs.append(tr.current_epoch))
+    patience = 2
+    early_stop = EarlyStopping(monitor="val_loss", patience=patience,
+                               verbose=True)
+    model = PlateauModel()
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=1),
+                          max_epochs=500, limit_train_batches=2,
+                          limit_val_batches=2,
+                          callbacks=[early_stop, probe],
+                          num_sanity_val_steps=0)
+    trainer.fit(model)
+    # epoch 0 sets the best score; epochs 1..patience fail to improve
+    assert trainer.current_epoch == patience
+    assert early_stop.stopped_epoch == patience
+    assert len(val_epochs) == patience + 1
+    assert trainer.should_stop
+    # best checkpoint exists and is reloadable (reference asserts
+    # load_from_checkpoint on the early-stopped run)
+    best = trainer.checkpoint_callback.best_model_path
+    assert best
+    trainer.validate(model, ckpt_path=best)
+
+
+def test_early_stop_strict_missing_metric(tmp_root):
+    from ray_lightning_tpu import EarlyStopping
+
+    model = BoringModel()
+    trainer = get_trainer(
+        tmp_root, strategy=RayStrategy(num_workers=1), max_epochs=2,
+        limit_train_batches=1, limit_val_batches=1,
+        callbacks=[EarlyStopping(monitor="nope", patience=1)],
+        num_sanity_val_steps=0, checkpoint_callback=False)
+    with pytest.raises(RuntimeError, match="nope"):
+        trainer.fit(model)

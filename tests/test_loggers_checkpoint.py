@@ -205,3 +205,99 @@ def test_lr_respects_grad_accumulation(tmp_root):
     trainer.fit(Scheduled())
     # 4 batches / accumulate 2 = 2 optimizer steps: lr = 1e-2 * 0.5^2
     assert trainer.current_lr == pytest.approx(2.5e-3, rel=1e-5)
+
+
+def test_orbax_async_save_roundtrip(tmp_root):
+    """async_save overlaps the disk commit with training; the fit-end wait
+    guarantees the directory is fully committed before results return."""
+    strategy = FSDPStrategy(num_workers=4)
+    trainer, model = _fit(tmp_root, [
+        ModelCheckpoint(save_format="orbax", monitor=None, save_top_k=1,
+                        async_save=True)
+    ], strategy=strategy, max_epochs=3)
+    best = trainer.checkpoint_callback.best_model_path
+    assert best.endswith(".orbax") and os.path.isdir(best)
+    ref_params = jax.device_get(trainer.train_state.params)
+
+    trainer2 = Trainer(strategy=FSDPStrategy(num_workers=2), max_epochs=0,
+                       default_root_dir=tmp_root, seed=0)
+    trainer2.fit(BoringModel(), ckpt_path=best)
+    got = jax.device_get(trainer2.train_state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_params),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_async_save_requires_orbax_format(tmp_root):
+    with pytest.raises(ValueError, match="async_save"):
+        ModelCheckpoint(save_format="stream", async_save=True)
+    trainer, _ = _fit(tmp_root, [], enable_checkpointing=False,
+                      max_epochs=0)
+    with pytest.raises(ValueError, match="async_save"):
+        trainer.save_checkpoint(os.path.join(tmp_root, "x.ckpt"),
+                                save_format="stream", async_save=True)
+
+
+def test_ema_weight_averaging_math(tmp_root):
+    """EMA tracks d*ema + (1-d)*params exactly, on-device, sharded."""
+    from ray_lightning_tpu import EMAWeightAveraging
+    from ray_lightning_tpu.core.callbacks import LambdaCallback
+
+    decay = 0.5
+    ema_cb = EMAWeightAveraging(decay=decay)
+    init_params = []
+    snapshots = []
+    probe = LambdaCallback(
+        on_train_start=lambda tr, m: init_params.append(
+            jax.device_get(tr.train_state.params)),
+        on_train_batch_end=lambda tr, m, out, b, i: snapshots.append(
+            jax.device_get(tr.train_state.params)))
+    _fit(tmp_root, [probe, ema_cb], strategy=RayStrategy(num_workers=2),
+         max_epochs=1, enable_checkpointing=False)
+    assert len(snapshots) == 3
+    # replay on host: ema_0 = p_init; ema_i = d*ema + (1-d)*p_i
+    expect = jax.tree_util.tree_map(np.asarray, init_params[0])
+    for snap in snapshots:
+        expect = jax.tree_util.tree_map(
+            lambda e, p: decay * e + (1 - decay) * np.asarray(p),
+            expect, snap)
+    got = jax.device_get(ema_cb.ema_params)
+    for a, b in zip(jax.tree_util.tree_leaves(expect),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+
+
+def test_ema_swap_validation_and_resume(tmp_root):
+    """swap_validation evaluates with the averaged weights (and restores
+    the raw ones after); the EMA state survives checkpoint resume."""
+    from ray_lightning_tpu import EMAWeightAveraging
+    from ray_lightning_tpu.core.callbacks import LambdaCallback
+
+    ema_cb = EMAWeightAveraging(decay=0.9, swap_validation=True)
+    val_params = []
+    probe = LambdaCallback(
+        on_validation_epoch_start=lambda tr, m: val_params.append(
+            jax.device_get(tr.train_state.params)))
+    trainer, _ = _fit(tmp_root, [ema_cb, probe],
+                      strategy=RayStrategy(num_workers=1), max_epochs=2,
+                      limit_val_batches=1, num_sanity_val_steps=0,
+                      enable_checkpointing=True)
+    raw = jax.device_get(trainer.train_state.params)
+    ema = jax.device_get(ema_cb.ema_params)
+    # validation ran with the EMA weights, not the raw ones
+    for v, e in zip(jax.tree_util.tree_leaves(val_params[-1]),
+                    jax.tree_util.tree_leaves(ema)):
+        np.testing.assert_allclose(np.asarray(v), np.asarray(e), rtol=1e-6)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(raw),
+                        jax.tree_util.tree_leaves(val_params[-1])))
+    # after fit the raw params are restored (swap undone)
+    best = trainer.checkpoint_callback.best_model_path
+    ema2_cb = EMAWeightAveraging(decay=0.9)
+    trainer2 = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=3,
+                       limit_train_batches=3, limit_val_batches=0,
+                       callbacks=[ema2_cb], default_root_dir=tmp_root,
+                       seed=0, enable_checkpointing=False)
+    trainer2.fit(BoringModel(), ckpt_path=best)
+    assert ema2_cb.ema_params is not None  # resumed + kept updating
