@@ -147,6 +147,40 @@ def _trial_bundles(
     return [head_bundle] + [bundle] * num_workers
 
 
+def resume_ckpt_path(checkpoint_dir: Optional[str] = None,
+                     filename: str = "checkpoint") -> Optional[str]:
+    """The trial's restore point, or ``None`` if Tune scheduled a fresh
+    start.
+
+    Call inside a trainable and hand the result to
+    ``Trainer.fit(..., ckpt_path=...)`` — this is what a PBT exploit step
+    (clone a better trial's weights, perturb hparams, continue) or a
+    failed-trial restore needs. Version-adaptive like :func:`_report`:
+    on legacy Ray pass the trainable's ``checkpoint_dir`` argument; on
+    Ray >= 2.x the checkpoint comes from ``tune.get_checkpoint()`` /
+    ``train.get_checkpoint()`` and is materialized to a local directory.
+    ``filename`` must match the ``TuneReportCheckpointCallback`` filename.
+    """
+    if checkpoint_dir is not None:  # legacy trainable argument
+        path = os.path.join(checkpoint_dir, filename)
+        return path if os.path.exists(path) else None
+    tune_mod = _require_tune()
+    get_ckpt = getattr(tune_mod, "get_checkpoint", None)
+    if get_ckpt is None:
+        try:
+            from ray import train as _train
+            get_ckpt = getattr(_train, "get_checkpoint", None)
+        except ImportError:
+            get_ckpt = None
+    if get_ckpt is None:
+        return None
+    ckpt = get_ckpt()
+    if ckpt is None:
+        return None
+    path = os.path.join(ckpt.to_directory(), filename)
+    return path if os.path.exists(path) else None
+
+
 def get_tune_resources(num_workers: int = 1,
                        num_cpus_per_worker: int = 1,
                        use_gpu: bool = False,

@@ -238,3 +238,36 @@ def test_tune2_checkpoint_travels_with_report(fake_tune2, tmp_path):
     ckpt = load_state_stream(checkpoint.files["checkpoint"])
     assert ckpt["global_step"] == 4
     assert "state" in ckpt and "params" in ckpt["state"]
+
+
+# --------------------------------------------------------------------- #
+# resume_ckpt_path (PBT exploit / trial-restore resume point)
+# --------------------------------------------------------------------- #
+def test_resume_ckpt_path_legacy_dir(tmp_path):
+    from ray_lightning_tpu.tune import resume_ckpt_path
+    d = tmp_path / "ckpt_0"
+    d.mkdir()
+    assert resume_ckpt_path(str(d)) is None  # no file yet
+    (d / "checkpoint").write_bytes(b"x")
+    assert resume_ckpt_path(str(d)) == str(d / "checkpoint")
+
+
+def test_resume_ckpt_path_tune2(fake_tune2, tmp_path, monkeypatch):
+    from ray_lightning_tpu.tune import resume_ckpt_path
+
+    assert resume_ckpt_path() is None  # FakeTune2 has no get_checkpoint
+
+    d = tmp_path / "cloned"
+    d.mkdir()
+    (d / "checkpoint").write_bytes(b"x")
+
+    class _Ckpt:
+        def to_directory(self):
+            return str(d)
+
+    monkeypatch.setattr(fake_tune2, "get_checkpoint", lambda: _Ckpt(),
+                        raising=False)
+    assert resume_ckpt_path() == str(d / "checkpoint")
+    monkeypatch.setattr(fake_tune2, "get_checkpoint", lambda: None,
+                        raising=False)
+    assert resume_ckpt_path() is None  # fresh start scheduled
