@@ -49,7 +49,8 @@ def main():
         # on v5e (see bench.py) — full fp32 master weights in the opt state
         cfg = bert_config("base", vocab_size=30522,
                           max_seq_len=args.seq_len, dtype=jnp.bfloat16,
-                          remat=True)
+                          remat=True,
+                          remat_policy="dots_with_no_batch_dims")
         module = BertModule(config=cfg, batch_size=args.batch_size,
                             seq_len=args.seq_len, num_samples=4096,
                             lr=args.lr)

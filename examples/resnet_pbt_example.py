@@ -77,8 +77,7 @@ def tune_pbt(args):
     # tune.get_checkpoint(); on legacy Ray add `checkpoint_dir=None` to
     # the lambda and forward it to train_resnet
     analysis = tune.run(
-        tune.with_parameters(
-            lambda cfg: train_resnet(cfg, args, callbacks=callbacks)),
+        lambda cfg: train_resnet(cfg, args, callbacks=callbacks),
         resources_per_trial=get_tune_resources(
             num_workers=args.num_workers, use_tpu=args.use_tpu),
         scheduler=pbt, metric="acc", mode="max",

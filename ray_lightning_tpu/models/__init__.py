@@ -13,6 +13,8 @@ from ray_lightning_tpu.models.moe import (MoeConfig, MoeModule,
                                           expert_parallel_rule, moe_config)
 from ray_lightning_tpu.models.pipelined_lm import (PipelinedLMModule,
                                                    PipelinedTransformerLM)
+from ray_lightning_tpu.models.vit import (ViTClassifier, ViTModule,
+                                          vit_config)
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
@@ -21,5 +23,5 @@ __all__ = [
     "BertModule", "BertClassifier", "bert_config", "ResNetModule",
     "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
-    "PipelinedTransformerLM"
+    "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config"
 ]

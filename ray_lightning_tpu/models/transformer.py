@@ -42,9 +42,27 @@ class TransformerConfig:
     causal: bool = True
     scan_layers: bool = True
     remat: bool = False
+    # None = rematerialize everything; "dots" saves matmul outputs and
+    # recomputes only elementwise ops (less recompute, more memory);
+    # "dots_with_no_batch_dims" saves weight-only matmuls
+    remat_policy: Optional[str] = None
     attention_impl: str = "dot"      # dot | flash | ring
     tie_embeddings: bool = True
     num_segments: int = 0            # >0 adds segment embeddings (BERT)
+
+    def __post_init__(self):
+        if self.remat_policy is not None:
+            if not self.remat:
+                raise ValueError(
+                    "remat_policy is set but remat=False — the policy "
+                    "would be silently ignored; pass remat=True (or drop "
+                    "the policy)")
+            if self.remat_policy not in ("dots",
+                                         "dots_with_no_batch_dims"):
+                raise ValueError(
+                    f"remat_policy must be 'dots', "
+                    f"'dots_with_no_batch_dims' or None, got "
+                    f"{self.remat_policy!r}")
 
     @property
     def head_dim(self) -> int:
@@ -177,6 +195,21 @@ class _ScanBlock(nn.Module):
         return (x, mask), None
 
 
+def _remat_policy(cfg: TransformerConfig):
+    if cfg.remat_policy is None:
+        return None
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_with_no_batch_dims":
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(f"remat_policy must be one of "
+                         f"{sorted(policies)} or None, got "
+                         f"{cfg.remat_policy!r}")
+    return policies[cfg.remat_policy]
+
+
 class TransformerStack(nn.Module):
     cfg: TransformerConfig
 
@@ -188,7 +221,7 @@ class TransformerStack(nn.Module):
             if cfg.remat:
                 block_cls = nn.remat(
                     _ScanBlock, prevent_cse=False,
-                    static_argnums=())
+                    static_argnums=(), policy=_remat_policy(cfg))
             stack = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},
@@ -200,7 +233,8 @@ class TransformerStack(nn.Module):
             return x
         block_cls = TransformerBlock
         if cfg.remat:
-            block_cls = nn.remat(TransformerBlock, prevent_cse=False)
+            block_cls = nn.remat(TransformerBlock, prevent_cse=False,
+                                 policy=_remat_policy(cfg))
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block_{i}")(
                 x, mask=mask, deterministic=deterministic)

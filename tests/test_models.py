@@ -55,10 +55,10 @@ def test_gpt_scan_vs_loop_equivalent(tmp_root):
 
 
 def test_gpt_remat_matches(tmp_root):
-    """Remat changes memory, not math."""
-    def run(remat):
+    """Remat (any policy) changes memory, not math."""
+    def run(remat, policy=None):
         cfg = gpt2_config("nano", vocab_size=256, max_seq_len=32,
-                          remat=remat)
+                          remat=remat, remat_policy=policy)
         model = GPTModule(config=cfg, batch_size=4, seq_len=32,
                           num_samples=32, lr=1e-3)
         trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
@@ -68,12 +68,17 @@ def test_gpt_remat_matches(tmp_root):
         trainer.fit(model)
         return jax.device_get(trainer.train_state.params)
 
-    p_base, p_remat = run(False), run(True)
-    for a, b in zip(jax.tree_util.tree_leaves(p_base),
-                    jax.tree_util.tree_leaves(p_remat)):
-        np.testing.assert_allclose(np.asarray(a, np.float32),
-                                   np.asarray(b, np.float32),
-                                   rtol=2e-3, atol=2e-4)
+    p_base = run(False)
+    for policy in (None, "dots", "dots_with_no_batch_dims"):
+        p_remat = run(True, policy)
+        for a, b in zip(jax.tree_util.tree_leaves(p_base),
+                        jax.tree_util.tree_leaves(p_remat)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-3, atol=2e-4)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        run(True, "bogus")
 
 
 def test_gpt2_param_counts():
@@ -134,3 +139,38 @@ def test_resnet_learns(tmp_root):
                           limit_val_batches=4, checkpoint_callback=False)
     trainer.fit(model)
     assert float(trainer.callback_metrics["val_acc"]) > 0.5
+
+
+def test_vit_learns(tmp_root):
+    from ray_lightning_tpu.models import ViTModule
+
+    model = ViTModule(size="tiny", image_size=16, patch_size=4,
+                      batch_size=32, num_samples=256, lr=1e-3)
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=3, limit_train_batches=8,
+                          limit_val_batches=4, checkpoint_callback=False)
+    trainer.fit(model)
+    acc = float(trainer.callback_metrics["val_acc"])
+    assert acc > 0.5, f"ViT did not learn separable prototypes: {acc}"
+
+
+def test_vit_fsdp_and_tp(tmp_root):
+    """The shared TransformerStack means vision gets the same parallel
+    layouts: FSDP sharding and the Megatron tensor-parallel rule."""
+    from ray_lightning_tpu import MeshStrategy
+    from ray_lightning_tpu.models import ViTModule
+    from ray_lightning_tpu.models.transformer import tensor_parallel_rule
+
+    from ray_lightning_tpu.models import vit_config
+    # n_heads must divide tp; "tiny" has 3 heads, so override to 4
+    cfg = vit_config("tiny", image_size=16, patch_size=4, n_heads=4)
+    for strategy in (FSDPStrategy(num_workers=4),
+                     MeshStrategy(axes={"dp": 2, "tp": 2},
+                                  param_rule=tensor_parallel_rule)):
+        model = ViTModule(image_size=16, patch_size=4,
+                          batch_size=16, num_samples=64, config=cfg)
+        trainer = get_trainer(tmp_root, strategy=strategy, max_epochs=1,
+                              limit_train_batches=2, limit_val_batches=0,
+                              checkpoint_callback=False)
+        trainer.fit(model)
+        assert trainer.global_step == 2
