@@ -7,11 +7,14 @@ from ray_lightning_tpu.core.callbacks import (Callback, EarlyStopping,
                                               ModelCheckpoint,
                                               EpochStatsCallback)
 from ray_lightning_tpu.core.loggers import CSVLogger, JaxProfilerCallback
+from ray_lightning_tpu.core.profiler import (PassThroughProfiler,
+                                             SimpleProfiler)
 from ray_lightning_tpu.core.seed import seed_everything, reset_seed
 
 __all__ = [
     "TpuModule", "TpuDataModule", "Trainer", "Callback", "EarlyStopping",
     "EMAWeightAveraging", "LambdaCallback",
     "LearningRateMonitor", "ModelCheckpoint", "EpochStatsCallback",
-    "CSVLogger", "JaxProfilerCallback", "seed_everything", "reset_seed"
+    "CSVLogger", "JaxProfilerCallback", "PassThroughProfiler",
+    "SimpleProfiler", "seed_everything", "reset_seed"
 ]

@@ -69,6 +69,7 @@ class Trainer:
                  precision: str = "32",
                  gradient_clip_val: Optional[float] = None,
                  accumulate_grad_batches: int = 1,
+                 profiler=None,
                  seed: Optional[int] = None):
         from ray_lightning_tpu.strategies.ddp import RayStrategy
         self.strategy = strategy if strategy is not None else RayStrategy(
@@ -90,6 +91,8 @@ class Trainer:
         self.precision = str(precision)
         self.gradient_clip_val = gradient_clip_val
         self.accumulate_grad_batches = int(accumulate_grad_batches)
+        from ray_lightning_tpu.core.profiler import resolve_profiler
+        self.profiler = resolve_profiler(profiler)
         self.seed = seed_everything(seed) if seed is not None else None
 
         if self.enable_checkpointing and not any(
@@ -368,6 +371,7 @@ class Trainer:
                     ckpt_path: Optional[str]) -> WorkerOutput:
         self._attach(module, datamodule)
         self.should_stop = False
+        getattr(self.profiler, "reset", lambda: None)()  # per-fit scope
         module.prepare_data()
         if datamodule is not None:
             datamodule.prepare_data()
@@ -436,14 +440,17 @@ class Trainer:
                                             self.limit_train_batches)
             t0 = time.perf_counter()
             for batch_idx, batch in enumerate(
-                    self._prefetch(train_loader, n_batches)):
+                    self.profiler.profile_iterable(
+                        self._prefetch(train_loader, n_batches),
+                        "get_train_batch")):
                 module.on_train_batch_start(batch, batch_idx)
                 for cb in self.callbacks:
                     cb.on_train_batch_start(self, module, batch, batch_idx)
                 module.on_before_optimizer_step(self._tx)
                 for cb in self.callbacks:
                     cb.on_before_optimizer_step(self, module, self._tx)
-                state, logs = self._train_step(state, batch)
+                with self.profiler.profile("train_step"):
+                    state, logs = self._train_step(state, batch)
                 self.train_state = state
                 self.global_step += 1
                 epoch_logs.append(logs)
@@ -474,11 +481,13 @@ class Trainer:
 
             if val_loader is not None and not stop and \
                     (epoch + 1) % self.check_val_every_n_epoch == 0:
-                self._run_validation(val_loader, module)
+                with self.profiler.profile("validation"):
+                    self._run_validation(val_loader, module)
 
             module.on_train_epoch_end()
-            for cb in self.callbacks:
-                cb.on_train_epoch_end(self, module)
+            with self.profiler.profile("epoch_end_callbacks"):
+                for cb in self.callbacks:
+                    cb.on_train_epoch_end(self, module)
             if stop or self.should_stop:
                 break
 
@@ -494,6 +503,8 @@ class Trainer:
 
         from ray_lightning_tpu.core.checkpoint import wait_for_async_saves
         wait_for_async_saves()
+        if self.strategy.global_rank == 0:
+            self.profiler.describe()
         return self._collect_rank_zero_results()
 
     def _run_validation(self, val_loader, module, limit=None):

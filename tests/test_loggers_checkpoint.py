@@ -301,3 +301,35 @@ def test_ema_swap_validation_and_resume(tmp_root):
                        seed=0, enable_checkpointing=False)
     trainer2.fit(BoringModel(), ckpt_path=best)
     assert ema2_cb.ema_params is not None  # resumed + kept updating
+
+
+def test_simple_profiler_sections(tmp_root, capsys):
+    """profiler="simple" times the hot-loop sections and reports at fit
+    end (PTL Trainer(profiler=...) parity seat, SURVEY.md §5)."""
+    trainer, _ = _fit(tmp_root, [], max_epochs=2, profiler="simple",
+                      limit_val_batches=2)
+    rec = trainer.profiler._records
+    assert rec["train_step"][0] == 6          # 2 epochs x 3 batches
+    assert rec["get_train_batch"][0] >= 6     # + exhausted-iterator calls
+    assert rec["validation"][0] == 2
+    s = trainer.profiler.summary()
+    assert "train_step" in s and "%" in s
+    assert "SimpleProfiler report" in capsys.readouterr().out
+
+
+def test_profiler_string_validation():
+    with pytest.raises(ValueError, match="profiler"):
+        Trainer(strategy=RayStrategy(num_workers=1), profiler="advanced")
+
+
+def test_simple_profiler_resets_per_fit(tmp_root):
+    trainer, _ = _fit(tmp_root, [], max_epochs=1, profiler="simple",
+                      limit_val_batches=0)
+    assert trainer.profiler._records["train_step"][0] == 3
+    trainer.fit(BoringModel())  # reused trainer: fresh report scope
+    assert trainer.profiler._records["train_step"][0] == 3
+
+
+def test_profiler_object_contract_enforced():
+    with pytest.raises(ValueError, match="lacks required"):
+        Trainer(strategy=RayStrategy(num_workers=1), profiler=True)
