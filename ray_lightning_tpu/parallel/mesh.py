@@ -50,8 +50,16 @@ class MeshSpec:
 
     ``axes`` maps axis name → size. A size of ``-1`` on at most one axis
     means "absorb all remaining devices" (like a reshape wildcard).
+
+    ``dcn_axes`` (multi-slice pods): axis name → how many ways that axis
+    crosses slice boundaries over DCN. Each entry must divide the axis's
+    total size; the remaining factor stays inside a slice on ICI, with the
+    DCN partition OUTER (slow links carry the outermost, least-frequent
+    collectives — the scaling-book recipe; typically only ``dp`` or ``pp``
+    belong here).
     """
     axes: Dict[str, int]
+    dcn_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         unknown = [a for a in self.axes if a not in _CANONICAL_ORDER]
@@ -61,6 +69,44 @@ class MeshSpec:
         wildcards = [a for a, s in self.axes.items() if s == -1]
         if len(wildcards) > 1:
             raise ValueError("At most one mesh axis may be -1 (wildcard)")
+        for a, d in self.dcn_axes.items():
+            if a not in self.axes:
+                raise ValueError(
+                    f"dcn_axes[{a!r}] has no matching entry in axes "
+                    f"({sorted(self.axes)})")
+            if d < 1:
+                raise ValueError(f"dcn_axes[{a!r}] must be >= 1, got {d}")
+            size = self.axes[a]
+            if size != -1 and size % d != 0:
+                raise ValueError(
+                    f"dcn_axes[{a!r}]={d} does not divide axes[{a!r}]="
+                    f"{size}")
+        if self.dcn_axes and wildcards:
+            raise ValueError(
+                "dcn_axes cannot be combined with a -1 wildcard axis — "
+                "resolve the axis sizes explicitly for multi-slice layouts")
+        if self.dcn_axes:
+            # Slice blocks must be contiguous in the mesh's flat device
+            # order (multi-host feeding assumes process-contiguous order,
+            # strategies/base.py assert_mesh_process_alignment): every
+            # axis OUTSIDE the last DCN-bearing axis must itself be fully
+            # DCN, otherwise iterating it re-visits slices (interleaving).
+            names = self.axis_names
+            last_dcn = max(i for i, a in enumerate(names)
+                           if a in self.dcn_axes)
+            for a in names[:last_dcn]:
+                if self.axes[a] != self.dcn_axes.get(a, 1):
+                    raise ValueError(
+                        f"dcn_axes must occupy the outermost mesh axes: "
+                        f"axis {a!r} (size {self.axes[a]}) lies outside "
+                        f"DCN-bearing axis {names[last_dcn]!r} but is not "
+                        f"fully DCN — either give {a!r} a dcn factor "
+                        f"equal to its size or move the DCN split to the "
+                        f"outermost axes (canonical order {names})")
+
+    @property
+    def num_slices(self) -> int:
+        return math.prod(self.dcn_axes.values()) if self.dcn_axes else 1
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
@@ -113,6 +159,9 @@ def build_mesh(spec: MeshSpec,
             f"Mesh spec {dict(zip(spec.axis_names, sizes))} needs {needed} "
             f"devices but only {len(devices)} are available")
     use = devices[:needed]
+    if spec.dcn_axes:
+        return Mesh(_hybrid_device_array(spec, sizes, use),
+                    spec.axis_names)
     if needed == len(devices) and use[0].platform == "tpu":
         try:
             dev_array = mesh_utils.create_device_mesh(
@@ -122,6 +171,47 @@ def build_mesh(spec: MeshSpec,
     else:
         dev_array = np.asarray(use).reshape(sizes)
     return Mesh(dev_array, spec.axis_names)
+
+
+def _hybrid_device_array(spec: MeshSpec, sizes: Sequence[int],
+                         use: Sequence[jax.Device]) -> np.ndarray:
+    """Device array for a multi-slice layout: DCN factors outer, ICI
+    factors inner, so within-slice neighbors differ only along ICI.
+
+    On real multislice TPU (devices carry ``slice_index``) this delegates
+    to ``mesh_utils.create_hybrid_device_mesh``. Off-TPU the slice
+    structure is EMULATED by chunking the device list into ``num_slices``
+    equal contiguous groups — the layout invariants (tested on the CPU
+    mesh) are identical, which is what makes multi-slice shardings
+    compile-checkable without a real pod.
+    """
+    names = spec.axis_names
+    dcn_sizes = [spec.dcn_axes.get(a, 1) for a in names]
+    ici_sizes = [s // d for s, d in zip(sizes, dcn_sizes)]
+    num_slices = math.prod(dcn_sizes)
+    if all(getattr(d, "slice_index", None) is not None for d in use):
+        # real multislice hardware: never fall back to emulation — a
+        # pseudo-slice chunking that straddles true slice boundaries would
+        # silently put ICI-only axes (tp/sp) on DCN
+        try:
+            return mesh_utils.create_hybrid_device_mesh(
+                ici_sizes, dcn_sizes, devices=np.asarray(use))
+        except (ValueError, AssertionError) as exc:
+            raise ValueError(
+                f"create_hybrid_device_mesh failed for ici={ici_sizes} "
+                f"dcn={dcn_sizes} over {len(use)} devices "
+                f"({num_slices} slices expected): {exc}") from exc
+    # emulated slices: contiguous chunks of the device list. Build the
+    # array so that indexing along axis k decomposes as
+    # (dcn_k outer, ici_k inner): first lay devices out as
+    # [slice grid (dcn_sizes)] x [per-slice grid (ici_sizes)], then
+    # interleave each axis's (dcn, ici) pair into one dimension.
+    arr = np.asarray(use).reshape(tuple(dcn_sizes) + tuple(ici_sizes))
+    n = len(names)
+    # permute (d0..dn-1, i0..in-1) -> (d0, i0, d1, i1, ...)
+    perm = [x for k in range(n) for x in (k, n + k)]
+    arr = arr.transpose(perm)
+    return arr.reshape(tuple(sizes))
 
 
 def multi_host_device_order(mesh: Mesh) -> List[int]:

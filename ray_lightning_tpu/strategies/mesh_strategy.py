@@ -28,14 +28,26 @@ class MeshStrategy(Strategy):
         param_rule: optional ``(path, leaf) -> PartitionSpec`` for
             parameters (tensor-parallel layouts); default shards along
             ``fsdp`` when present, else replicates.
+        dcn_axes: multi-slice pods — axis → DCN factor (how many ways the
+            axis crosses slice boundaries; must divide the axis size). The
+            DCN partition is laid out OUTER so cross-slice traffic carries
+            only that axis's collectives (put ``dp`` here; keep tp/sp on
+            ICI). E.g. two v4-32 slices running dp=8 × tp=4:
+            ``MeshStrategy(axes={"dp": 8, "tp": 4}, dcn_axes={"dp": 2})``.
     """
     strategy_name = "mesh_tpu"
 
     def __init__(self,
                  axes: Dict[str, int],
                  param_rule: Optional[Callable] = None,
+                 dcn_axes: Optional[Dict[str, int]] = None,
                  **kwargs):
         self._axes = dict(axes)
+        self._dcn_axes = dict(dcn_axes or {})
+        # fail fast on spec errors (axis typos, non-dividing or
+        # non-outermost dcn factors) at the construction site — the spec
+        # needs no device count, so this is safe driver-side
+        MeshSpec(self._axes, dcn_axes=self._dcn_axes)
         self._param_rule = param_rule
         if "num_workers" not in kwargs:
             # product of the fixed axes; with a -1 wildcard the true world
@@ -46,7 +58,7 @@ class MeshStrategy(Strategy):
         super().__init__(**kwargs)
 
     def mesh_spec(self) -> MeshSpec:
-        return MeshSpec(self._axes)
+        return MeshSpec(self._axes, dcn_axes=self._dcn_axes)
 
     @property
     def world_size(self) -> int:
