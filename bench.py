@@ -2,8 +2,10 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 The primary metric stays samples/sec/chip on the MNIST classifier train step
-(BASELINE.json "metric"); extras carry the BERT-base number, MFU for both,
-the virtual-mesh scaling proxy, and real-chip batch scaling.
+(BASELINE.json "metric"); extras carry BERT-base and GPT-2-small (the
+flagship) numbers with MFU, the pallas-flash long-seq comparison, the
+virtual-mesh scaling proxy, real-chip batch scaling, and the native
+data-pipeline measurement.
 
 Measurement design (the round-1 bench silently clamped a collapsed
 differential to 1e-9 s and recorded 2e14 samples/s — see VERDICT.md):
@@ -95,11 +97,31 @@ def _transformer_train_flops(state, tokens_per_step: int) -> float:
     return 6.0 * n_params * tokens_per_step
 
 
-def _build_mnist_step(strategy, batch_size: int):
+def _assemble_step(strategy, model, tx, loss_fn, init_batch, batch):
+    """Shared builder tail: sharded init + compiled train step + batch
+    placement (identical across the MNIST/BERT/GPT-2 benches)."""
     import jax
-    import optax
 
     from ray_lightning_tpu.core.train_state import TrainState
+
+    def init_fn(rng):
+        params = model.init(rng, init_batch)["params"]
+        return TrainState.create(params, tx.init(params))
+
+    state_shardings = jax.tree_util.tree_map(
+        lambda _: strategy.scalar_sharding(),
+        jax.eval_shape(init_fn, jax.random.PRNGKey(0)))
+    state = jax.jit(init_fn, out_shardings=state_shardings)(
+        jax.random.PRNGKey(0))
+    step = strategy.make_train_step(loss_fn, tx, state_shardings,
+                                    strategy.batch_sharding())
+    batch = jax.device_put(batch, strategy.batch_sharding())
+    return step, state, batch
+
+
+def _build_mnist_step(strategy, batch_size: int):
+    import optax
+
     from ray_lightning_tpu.data.synthetic import synthetic_mnist
     from ray_lightning_tpu.models.mnist import MNISTNet
 
@@ -114,27 +136,13 @@ def _build_mnist_step(strategy, batch_size: int):
             logits, by).mean()
         return loss, ({}, model_state)
 
-    def init_fn(rng):
-        params = model.init(rng, x[:1])["params"]
-        return TrainState.create(params, tx.init(params))
-
-    state_shardings = jax.tree_util.tree_map(
-        lambda _: strategy.scalar_sharding(),
-        jax.eval_shape(init_fn, jax.random.PRNGKey(0)))
-    state = jax.jit(init_fn, out_shardings=state_shardings)(
-        jax.random.PRNGKey(0))
-    step = strategy.make_train_step(loss_fn, tx, state_shardings,
-                                    strategy.batch_sharding())
-    batch = jax.device_put((x, y), strategy.batch_sharding())
-    return step, state, batch
+    return _assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
 
 
 def _build_bert_step(strategy, batch_size: int, seq_len: int):
-    import jax
     import jax.numpy as jnp
     import optax
 
-    from ray_lightning_tpu.core.train_state import TrainState
     from ray_lightning_tpu.models.bert import (BertClassifier, bert_config,
                                                _synthetic_classification_tokens)
 
@@ -153,19 +161,41 @@ def _build_bert_step(strategy, batch_size: int, seq_len: int):
             logits, labels).mean()
         return loss, ({}, model_state)
 
-    def init_fn(rng):
-        params = model.init(rng, x[:1])["params"]
-        return TrainState.create(params, tx.init(params))
+    return _assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
 
-    state_shardings = jax.tree_util.tree_map(
-        lambda _: strategy.scalar_sharding(),
-        jax.eval_shape(init_fn, jax.random.PRNGKey(0)))
-    state = jax.jit(init_fn, out_shardings=state_shardings)(
-        jax.random.PRNGKey(0))
-    step = strategy.make_train_step(loss_fn, tx, state_shardings,
-                                    strategy.batch_sharding())
-    batch = jax.device_put((x, y), strategy.batch_sharding())
-    return step, state, batch
+
+def _build_gpt2_step(strategy, batch_size: int, seq_len: int):
+    """Flagship model (GPT-2-small, the ``entry()`` model) train step.
+
+    Config from the v5e sweep: bs 8 / seq 512 / bf16 / scanned layers /
+    remat(dots_with_no_batch_dims), vocab padded 50257→50304 (x128
+    multiple keeps the LM-head matmul MXU-aligned: +9% measured).
+    Sweep: bs8@512→247 sps (MFU .478), bs16→212, bs32→200, full
+    remat→184, seq1024→collapses to MFU .27 (T^2 attention).
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+
+    cfg = gpt2_config("small", vocab_size=50304, max_seq_len=seq_len,
+                      dtype=jnp.bfloat16, scan_layers=True, remat=True,
+                      remat_policy="dots_with_no_batch_dims")
+    model = TransformerLM(cfg)
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    toks = np.random.default_rng(0).integers(
+        0, 50257, size=(batch_size, seq_len + 1)).astype(np.int32)
+
+    def loss_fn(params, model_state, batch, rng):
+        x, y = batch[:, :-1], batch[:, 1:]
+        logits = model.apply({"params": params}, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, ({}, model_state)
+
+    return _assemble_step(strategy, model, tx, loss_fn, toks[:1, :-1],
+                          toks)
 
 
 def _measure_rate(step, state, batch, samples_per_step: int,
@@ -557,6 +587,22 @@ def main() -> None:
         }
     except Exception as exc:  # secondary benches degrade to a diagnostic
         extras["bert_base"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        gpt_bs, gpt_seq = 8, 512
+        gpt = bench_model(_build_gpt2_step, samples_per_step=gpt_bs,
+                          analytic_tokens=gpt_bs * gpt_seq,
+                          batch_size=gpt_bs, seq_len=gpt_seq, best_of=2)
+        extras["gpt2_small"] = {
+            "samples_per_sec_per_chip": round(
+                gpt["samples_per_sec_per_chip"], 2),
+            "tokens_per_sec_per_chip": round(
+                gpt["samples_per_sec_per_chip"] * gpt_seq, 0),
+            "mfu": round(gpt["mfu"], 4) if gpt["mfu"] else None,
+            "batch": gpt_bs, "seq_len": gpt_seq,
+        }
+    except Exception as exc:
+        extras["gpt2_small"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     try:
         extras["flash_attention_t8192"] = _bench_flash_long_seq()

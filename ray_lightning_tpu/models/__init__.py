@@ -15,6 +15,7 @@ from ray_lightning_tpu.models.pipelined_lm import (PipelinedLMModule,
                                                    PipelinedTransformerLM)
 from ray_lightning_tpu.models.vit import (ViTClassifier, ViTModule,
                                           vit_config)
+from ray_lightning_tpu.models.generate import generate, sample_logits
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
@@ -23,5 +24,6 @@ __all__ = [
     "BertModule", "BertClassifier", "bert_config", "ResNetModule",
     "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
-    "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config"
+    "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config",
+    "generate", "sample_logits"
 ]

@@ -46,6 +46,10 @@ class TransformerConfig:
     # recomputes only elementwise ops (less recompute, more memory);
     # "dots_with_no_batch_dims" saves weight-only matmuls
     remat_policy: Optional[str] = None
+    # autoregressive decode mode: attention keeps a KV cache sized
+    # max_seq_len in the "cache" variable collection and consumes ONE
+    # token per call (see models/generate.py)
+    decode: bool = False
     attention_impl: str = "dot"      # dot | flash | ring
     tie_embeddings: bool = True
     num_segments: int = 0            # >0 adds segment embeddings (BERT)
@@ -135,17 +139,55 @@ class MultiHeadAttention(nn.Module):
             features=(3, cfg.n_heads, cfg.head_dim), axis=-1,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
         q, k, v = jnp.moveaxis(qkv, 2, 0)  # 3 × (B, T, H, D)
+        causal = cfg.causal
+        if cfg.decode:
+            k, v, cache_mask = self._decode_cache(k, v)
+            if cache_mask is not None:
+                # combine with any caller mask (e.g. left-pad masking for
+                # batched prompts) — both are additive 0/-inf biases
+                mask = cache_mask if mask is None else mask + cache_mask
+            causal = False  # the cache mask already encodes causality
         drop_rng = None
         if cfg.dropout > 0.0 and not deterministic:
             drop_rng = self.make_rng("dropout")
         attn = _attention_fn(cfg)
-        out = attn(q, k, v, causal=cfg.causal, mask=mask,
+        out = attn(q, k, v, causal=causal, mask=mask,
                    dropout_rate=cfg.dropout if not deterministic else 0.0,
                    dropout_rng=drop_rng)
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
         return nn.DenseGeneral(
             features=cfg.d_model, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="out")(out)
+
+    def _decode_cache(self, k, v):
+        """One-token KV-cache update (flax decode pattern): the "cache"
+        collection holds keys/values for all ``max_seq_len`` positions;
+        each call writes the new token at ``cache_index`` and attends over
+        positions ``<= cache_index`` via an additive mask."""
+        cfg = self.cfg
+        B, T, H, D = k.shape
+        is_init = not self.has_variable("cache", "cached_key")
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (B, cfg.max_seq_len, H, D), k.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (B, cfg.max_seq_len, H, D), v.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if is_init:  # shape-building init pass: no cache semantics yet
+            return k, v, None
+        if T != 1:
+            raise ValueError(
+                f"decode mode consumes one token per call, got T={T}; "
+                "feed the prompt token-by-token (models/generate.py)")
+        idx = ci.value
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        ci.value = idx + T
+        key_pos = jax.lax.broadcasted_iota(jnp.int32,
+                                           (1, 1, 1, cfg.max_seq_len), 3)
+        big_neg = jnp.finfo(jnp.float32).min
+        mask = jnp.where(key_pos <= idx, 0.0, big_neg)
+        return ck.value, cv.value, mask
 
 
 class MlpBlock(nn.Module):
@@ -224,7 +266,7 @@ class TransformerStack(nn.Module):
                     static_argnums=(), policy=_remat_policy(cfg))
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"})
@@ -242,18 +284,24 @@ class TransformerStack(nn.Module):
 
 
 class TransformerLM(nn.Module):
-    """GPT-style causal language model (token + learned position embeds)."""
+    """GPT-style causal language model (token + learned position embeds).
+
+    ``positions`` (B, T) overrides the default 0..T-1 position ids —
+    required in decode mode, where each single-token call sits at the
+    current cache index (see :mod:`ray_lightning_tpu.models.generate`).
+    """
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True, positions=None):
         cfg = self.cfg
         B, T = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model,
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        name="wte")
         x = wte(tokens)
-        pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        pos = positions if positions is not None else \
+            jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wpe")(pos)
         x = TransformerStack(cfg, name="stack")(

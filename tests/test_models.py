@@ -174,3 +174,68 @@ def test_vit_fsdp_and_tp(tmp_root):
                               checkpoint_callback=False)
         trainer.fit(model)
         assert trainer.global_step == 2
+
+
+def test_generate_kv_cache_matches_naive_greedy():
+    """One-token cached decode must reproduce full-recompute greedy
+    decoding exactly — the KV cache is an optimization, not a model."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models import TransformerLM, gpt2_config
+    from ray_lightning_tpu.models.generate import generate
+
+    # fp32 throughout: the cached (1-token) and naive (full-seq)
+    # paths accumulate in different shapes, and bf16 rounding could
+    # split near-tied argmaxes spuriously
+    train_cfg = gpt2_config("nano", vocab_size=128, max_seq_len=32,
+                            dtype=jnp.float32)
+    dec_cfg = gpt2_config("nano", vocab_size=128, max_seq_len=32,
+                          dtype=jnp.float32, decode=True)
+    model = TransformerLM(train_cfg)
+    prompt = np.array([[5, 17, 3], [9, 2, 44]], dtype=np.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    out = generate(TransformerLM(dec_cfg), params, jnp.asarray(prompt),
+                   max_new_tokens=6, rng=jax.random.PRNGKey(1),
+                   temperature=0.0)
+    toks = prompt.copy()
+    for _ in range(6):
+        logits = model.apply({"params": params}, jnp.asarray(toks))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1), dtype=np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    assert np.array_equal(np.asarray(out), toks)
+
+
+def test_generate_sampling_and_validation():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from ray_lightning_tpu.models import TransformerLM, gpt2_config
+    from ray_lightning_tpu.models.generate import generate
+
+    dec_cfg = gpt2_config("nano", vocab_size=64, max_seq_len=16,
+                          dtype=jnp.float32, decode=True)
+    train_cfg = gpt2_config("nano", vocab_size=64, max_seq_len=16,
+                            dtype=jnp.float32)
+    prompt = np.array([[1, 2]], dtype=np.int32)
+    params = TransformerLM(train_cfg).init(
+        jax.random.PRNGKey(0), prompt)["params"]
+    dec = TransformerLM(dec_cfg)
+
+    # top_k=1 at any temperature is greedy
+    greedy = generate(dec, params, jnp.asarray(prompt), max_new_tokens=4,
+                      rng=jax.random.PRNGKey(2), temperature=0.0)
+    k1 = generate(dec, params, jnp.asarray(prompt), max_new_tokens=4,
+                  rng=jax.random.PRNGKey(3), temperature=1.7, top_k=1)
+    assert np.array_equal(np.asarray(greedy), np.asarray(k1))
+    # stochastic sampling stays in-vocab
+    s = generate(dec, params, jnp.asarray(prompt), max_new_tokens=8,
+                 rng=jax.random.PRNGKey(4), temperature=1.0, top_k=8)
+    assert int(np.asarray(s).max()) < 64 and s.shape == (1, 10)
+
+    with _pytest.raises(ValueError, match="decode=True"):
+        generate(TransformerLM(train_cfg), params, jnp.asarray(prompt),
+                 max_new_tokens=4, rng=jax.random.PRNGKey(0))
+    with _pytest.raises(ValueError, match="max_seq_len"):
+        generate(dec, params, jnp.asarray(prompt), max_new_tokens=30,
+                 rng=jax.random.PRNGKey(0))
