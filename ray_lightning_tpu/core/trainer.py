@@ -62,6 +62,7 @@ class Trainer:
                  limit_predict_batches: Optional[float] = None,
                  num_sanity_val_steps: int = 0,
                  check_val_every_n_epoch: int = 1,
+                 val_check_interval=None,
                  enable_checkpointing: bool = False,
                  default_root_dir: Optional[str] = None,
                  enable_progress_bar: bool = False,
@@ -69,6 +70,7 @@ class Trainer:
                  precision: str = "32",
                  gradient_clip_val: Optional[float] = None,
                  accumulate_grad_batches: int = 1,
+                 track_grad_norm: bool = False,
                  profiler=None,
                  seed: Optional[int] = None):
         from ray_lightning_tpu.strategies.ddp import RayStrategy
@@ -83,6 +85,17 @@ class Trainer:
         self.limit_predict_batches = limit_predict_batches
         self.num_sanity_val_steps = num_sanity_val_steps
         self.check_val_every_n_epoch = check_val_every_n_epoch
+        if val_check_interval is not None:
+            if isinstance(val_check_interval, float):
+                if not 0.0 < val_check_interval <= 1.0:
+                    raise ValueError(
+                        f"float val_check_interval must be in (0, 1], got "
+                        f"{val_check_interval}")
+            elif int(val_check_interval) < 1:
+                raise ValueError(
+                    f"int val_check_interval must be >= 1, got "
+                    f"{val_check_interval}")
+        self.val_check_interval = val_check_interval
         self.enable_checkpointing = enable_checkpointing
         self.default_root_dir = default_root_dir or os.path.join(
             os.getcwd(), "tpu_lightning_logs")
@@ -91,6 +104,7 @@ class Trainer:
         self.precision = str(precision)
         self.gradient_clip_val = gradient_clip_val
         self.accumulate_grad_batches = int(accumulate_grad_batches)
+        self.track_grad_norm = bool(track_grad_norm)
         from ray_lightning_tpu.core.profiler import resolve_profiler
         self.profiler = resolve_profiler(profiler)
         self.seed = seed_everything(seed) if seed is not None else None
@@ -348,7 +362,8 @@ class Trainer:
             return eval_fn
 
         train_step = strategy.make_train_step(
-            loss_fn, tx, state_shardings, batch_sharding)
+            loss_fn, tx, state_shardings, batch_sharding,
+            log_grad_norm=self.track_grad_norm)
         val_step = strategy.make_eval_step(
             eval_fn_builder("validation_step"), state_shardings,
             batch_sharding)
@@ -438,6 +453,27 @@ class Trainer:
             epoch_logs: List[Dict[str, Any]] = []
             n_batches = self._resolve_limit(train_loader,
                                             self.limit_train_batches)
+            # mid-epoch validation cadence (PTL val_check_interval):
+            # float f = every int(f * n_batches) batches of this epoch;
+            # int N = every N train batches counted across epochs.
+            # check_val_every_n_epoch still gates WHICH epochs validate;
+            # the interval subdivides those epochs (PTL composition).
+            epoch_validates = (epoch + 1) % self.check_val_every_n_epoch \
+                == 0
+            val_every = 0
+            if self.val_check_interval is not None and \
+                    val_loader is not None and epoch_validates:
+                if isinstance(self.val_check_interval, float):
+                    if n_batches >= 2**31:
+                        raise ValueError(
+                            "a float val_check_interval needs a sized "
+                            "train dataloader (or an integer "
+                            "limit_train_batches) to resolve the epoch "
+                            "length; pass an int interval instead")
+                    val_every = max(1, int(self.val_check_interval
+                                           * n_batches))
+                else:
+                    val_every = int(self.val_check_interval)
             t0 = time.perf_counter()
             for batch_idx, batch in enumerate(
                     self.profiler.profile_iterable(
@@ -461,6 +497,13 @@ class Trainer:
                                           batch_idx)
                 if hasattr(self._launcher, "drain_queue"):
                     self._launcher.drain_queue()
+                if val_every:
+                    count = (batch_idx + 1 if isinstance(
+                        self.val_check_interval, float)
+                        else self.global_step)
+                    if count % val_every == 0:
+                        with self.profiler.profile("validation"):
+                            self._run_validation(val_loader, module)
                 if 0 <= self.max_steps <= self.global_step:
                     stop = True
                     break
@@ -479,8 +522,17 @@ class Trainer:
                                 if np.isscalar(v))
                 print(f"epoch {epoch}: {msg} ({dt:.1f}s)")
 
-            if val_loader is not None and not stop and \
-                    (epoch + 1) % self.check_val_every_n_epoch == 0:
+            run_epoch_val = val_loader is not None and not stop and \
+                epoch_validates
+            if val_every:
+                # interval mode owns validation; the epoch boundary only
+                # adds one for a float interval that doesn't divide the
+                # epoch (PTL: f=0.5 validates at 50% and 100%)
+                run_epoch_val = (run_epoch_val
+                                 and isinstance(self.val_check_interval,
+                                                float)
+                                 and n_batches % val_every != 0)
+            if run_epoch_val:
                 with self.profiler.profile("validation"):
                     self._run_validation(val_loader, module)
 

@@ -36,7 +36,8 @@ class HorovodRayStrategy(Strategy):
 
     def make_train_step(self, loss_fn: Callable, tx: optax.GradientTransformation,
                         state_shardings: Any, batch_sharding: NamedSharding,
-                        donate: bool = True) -> Callable:
+                        donate: bool = True,
+                        log_grad_norm: bool = False) -> Callable:
         mesh = self.mesh
 
         def per_rank_step(state, batch):
@@ -52,6 +53,8 @@ class HorovodRayStrategy(Strategy):
             # The explicit allreduce — hvd.allreduce ≙ lax.pmean over ICI.
             grads = jax.lax.pmean(grads, DP_AXIS)
             loss = jax.lax.pmean(loss, DP_AXIS)
+            if log_grad_norm:  # post-allreduce: the effective update norm
+                logs = {**logs, "grad_norm": optax.global_norm(grads)}
             logs = jax.tree_util.tree_map(
                 lambda x: jax.lax.pmean(x, DP_AXIS)
                 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,

@@ -214,7 +214,8 @@ class Strategy:
 
     def make_train_step(self, loss_fn: Callable, tx: Any,
                         state_shardings: Any, batch_sharding: NamedSharding,
-                        donate: bool = True) -> Callable:
+                        donate: bool = True,
+                        log_grad_norm: bool = False) -> Callable:
         """Build the compiled training step: ``state', logs = step(state, batch)``.
 
         The jit path: gradient synchronization is *derived* by XLA from the
@@ -223,6 +224,10 @@ class Strategy:
         DDP wrapper as the seat of gradient sync (``ray_ddp.py:202-206``).
         Strategies needing explicit per-rank collectives (Horovod parity)
         override this with a ``shard_map`` version.
+
+        ``log_grad_norm`` adds the pre-clip global gradient norm to the
+        step logs — computed inside the same XLA program (fused with the
+        update), so it costs no extra host sync.
         """
         import optax
 
@@ -231,6 +236,8 @@ class Strategy:
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
             (loss, (logs, new_ms)), grads = grad_fn(
                 state.params, state.model_state, batch, rng)
+            if log_grad_norm:
+                logs = {**logs, "grad_norm": optax.global_norm(grads)}
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = state.replace(

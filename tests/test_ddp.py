@@ -368,3 +368,102 @@ def test_early_stop_strict_missing_metric(tmp_root):
         num_sanity_val_steps=0, checkpoint_callback=False)
     with pytest.raises(RuntimeError, match="nope"):
         trainer.fit(model)
+
+
+def test_track_grad_norm(tmp_root):
+    """track_grad_norm logs the pre-clip global grad norm from inside the
+    compiled step (no extra host sync)."""
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=1, limit_train_batches=3,
+                          limit_val_batches=0, checkpoint_callback=False,
+                          track_grad_norm=True)
+    trainer.fit(model)
+    gn = trainer.callback_metrics.get("train_grad_norm",
+                                      trainer.callback_metrics.get(
+                                          "grad_norm"))
+    assert gn is not None and float(gn) > 0
+
+
+def test_track_grad_norm_allreduce(tmp_root):
+    from ray_lightning_tpu import HorovodRayStrategy
+
+    model = BoringModel()
+    trainer = get_trainer(tmp_root,
+                          strategy=HorovodRayStrategy(num_workers=2),
+                          max_epochs=1, limit_train_batches=2,
+                          limit_val_batches=0, checkpoint_callback=False,
+                          track_grad_norm=True)
+    trainer.fit(model)
+    gn = trainer.callback_metrics.get("train_grad_norm",
+                                      trainer.callback_metrics.get(
+                                          "grad_norm"))
+    assert gn is not None and float(gn) > 0
+
+
+@pytest.mark.parametrize("interval,expect", [
+    (0.5, 4),   # 6 batches/epoch: at batch 3 and 6, x2 epochs
+    (2, 6),     # every 2 global steps over 12 total steps
+])
+def test_val_check_interval(tmp_root, interval, expect):
+    from ray_lightning_tpu.core.callbacks import LambdaCallback
+
+    vals = []
+    probe = LambdaCallback(
+        on_validation_end=lambda tr, m: vals.append(tr.global_step))
+    model = BoringModel()
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=1),
+                          max_epochs=2, limit_train_batches=6,
+                          limit_val_batches=1, callbacks=[probe],
+                          checkpoint_callback=False,
+                          num_sanity_val_steps=0,
+                          val_check_interval=interval)
+    trainer.fit(model)
+    assert len(vals) == expect, vals
+
+
+def test_val_check_interval_validation():
+    with pytest.raises(ValueError, match="val_check_interval"):
+        Trainer(strategy=RayStrategy(num_workers=1),
+                val_check_interval=1.5)
+    with pytest.raises(ValueError, match="val_check_interval"):
+        Trainer(strategy=RayStrategy(num_workers=1), val_check_interval=0)
+
+
+def test_val_check_interval_respects_epoch_gate(tmp_root):
+    """check_val_every_n_epoch gates which epochs validate; the interval
+    subdivides only those (PTL composition)."""
+    from ray_lightning_tpu.core.callbacks import LambdaCallback
+
+    vals = []
+    probe = LambdaCallback(
+        on_validation_end=lambda tr, m: vals.append(
+            (tr.current_epoch, tr.global_step)))
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=1),
+                          max_epochs=4, limit_train_batches=4,
+                          limit_val_batches=1, callbacks=[probe],
+                          checkpoint_callback=False,
+                          num_sanity_val_steps=0,
+                          check_val_every_n_epoch=2,
+                          val_check_interval=0.5)
+    trainer.fit(BoringModel())
+    # only epochs 1 and 3 validate, twice each (at 50% and 100%)
+    assert [e for e, _ in vals] == [1, 1, 3, 3], vals
+
+
+def test_val_check_interval_unsized_loader_raises(tmp_root):
+    class Unsized(BoringModel):
+        def train_dataloader(self):
+            inner = super().train_dataloader()
+
+            class _NoLen:
+                def __iter__(self):
+                    return iter(inner)
+            return _NoLen()
+
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=1),
+                          max_epochs=1, limit_train_batches=None,
+                          limit_val_batches=1, checkpoint_callback=False,
+                          num_sanity_val_steps=0, val_check_interval=0.5)
+    with pytest.raises(ValueError, match="sized train dataloader"):
+        trainer.fit(Unsized())
