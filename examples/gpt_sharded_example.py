@@ -28,6 +28,9 @@ def main():
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--max-epochs", type=int, default=2)
+    parser.add_argument("--generate", type=int, default=0, metavar="N",
+                        help="after training, decode N tokens from the "
+                             "trained weights with the KV-cache sampler")
     parser.add_argument("--smoke-test", action="store_true", default=False)
     args = parser.parse_args()
 
@@ -46,6 +49,29 @@ def main():
     trainer.fit(model)
     print("callback_metrics:",
           {k: round(float(v), 4) for k, v in trainer.callback_metrics.items()})
+
+    if args.generate:
+        import dataclasses
+
+        import jax
+        import numpy as np
+
+        from ray_lightning_tpu.models import TransformerLM, generate
+
+        # decode needs no remat (single-token steps store no activations)
+        dec_cfg = dataclasses.replace(model.cfg, decode=True, remat=False,
+                                      remat_policy=None)
+        if trainer.train_state is not None:  # local launch: live arrays
+            params = trainer.train_state.params
+        else:  # Ray launch: the driver recovered a host state dict
+            params = trainer.train_state_dict["params"]
+        prompt = np.asarray(
+            [[1, 2, 3, 4]], dtype=np.int32)
+        out = generate(TransformerLM(dec_cfg), params,
+                       prompt, max_new_tokens=args.generate,
+                       rng=jax.random.PRNGKey(0), temperature=0.8,
+                       top_k=40)
+        print("generated:", np.asarray(out)[0].tolist())
 
 
 if __name__ == "__main__":
