@@ -156,8 +156,25 @@ class ProcessActorHandle:
         with self._send_lock:
             with self._pending_lock:
                 self._pending.append(fut)
-            self._conn.send(message)
+            try:
+                self._conn.send(message)
+            except (BrokenPipeError, OSError) as exc:
+                # actor already dead: fail THIS future like the reader
+                # fails in-flight ones — callers see one uniform
+                # "actor died" error instead of a raw pipe error
+                with self._pending_lock:
+                    if fut in self._pending:
+                        self._pending.remove(fut)
+                fut._resolve(error=self._death_error(exc))
         return fut
+
+    def _death_error(self, exc: Optional[BaseException] = None
+                     ) -> RuntimeError:
+        """The one actor-died error, shared by every failure path."""
+        suffix = f": {exc}" if exc is not None else ""
+        return RuntimeError(
+            f"actor process pid={self._proc.pid} died "
+            f"(exitcode={self._proc.exitcode}){suffix}")
 
     def _read_loop(self) -> None:
         while True:
@@ -165,9 +182,7 @@ class ProcessActorHandle:
                 status, payload = self._conn.recv()
             except (EOFError, OSError):
                 # process died: fail everything still in flight
-                err = RuntimeError(
-                    f"actor process pid={self._proc.pid} died "
-                    f"(exitcode={self._proc.exitcode})")
+                err = self._death_error()
                 with self._pending_lock:
                     pending, self._pending = self._pending, []
                 for fut in pending:

@@ -328,3 +328,41 @@ def test_host_local_batch_feeding_two_processes(tmp_path):
         ray_mod.shutdown()
     for got, want in results:
         np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def _die_hard():
+    import os as _os
+    import signal as _signal
+    _os.kill(_os.getpid(), _signal.SIGKILL)
+
+
+@pytest.mark.multiproc
+def test_worker_hard_death_fails_fast(tmp_path):
+    """A SIGKILLed worker (OOM-killer / preemption stand-in, no Python
+    exception to propagate) must fail the driver's get promptly — the
+    reference's fault model is ray.get raising on actor death
+    (``ray_lightning/util.py:57-70``), not a hang."""
+    ray_mod = _make_backend()
+    ray_mod.init()
+    try:
+        Actor = ray_mod.remote(_Echo)
+        a = Actor.remote()
+        assert ray_mod.get(a.execute.remote(_noop)) is None
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="died"):
+            ray_mod.get(a.execute.remote(_die_hard), timeout=30)
+        assert time.time() - t0 < 30
+        # subsequent calls on the dead actor fail too, not hang
+        with pytest.raises(RuntimeError):
+            ray_mod.get(a.execute.remote(_noop), timeout=10)
+    finally:
+        ray_mod.shutdown()
+
+
+def _noop():
+    return None
+
+
+class _Echo:
+    def execute(self, fn, *args):
+        return fn(*args)
