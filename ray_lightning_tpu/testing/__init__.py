@@ -8,5 +8,7 @@ drives the full :class:`~ray_lightning_tpu.launchers.ray_launcher.RayLauncher`
 pipeline in-process.
 """
 from ray_lightning_tpu.testing.fake_ray import FakeRay
+from ray_lightning_tpu.testing.determinism import (assert_deterministic,
+                                                   fit_fingerprint)
 
-__all__ = ["FakeRay"]
+__all__ = ["FakeRay", "assert_deterministic", "fit_fingerprint"]

@@ -1,0 +1,81 @@
+"""Determinism checking — the closest thing the reference has to a race
+detector is its deterministic-seed plumbing (``PL_GLOBAL_SEED`` forwarding
++ per-worker ``reset_seed``, SURVEY.md §5); this utility turns that into
+an executable assertion users can run against their own modules.
+
+On TPU, determinism is a stronger claim than on GPU (no atomics-order
+nondeterminism in XLA reductions), so same-seed same-params is the
+expected contract — a failure means host-side state leaked into the step
+(unseeded numpy/python RNG, time-dependent data order, stateful modules).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+def fit_fingerprint(trainer) -> np.ndarray:
+    """A flat host digest of the trainer's final params.
+
+    Works on both recovery paths: live arrays (local launch) and the
+    host state dict a remote launch leaves on the driver
+    (``trainer.train_state_dict``, core/trainer.py ``_recover_results``).
+    """
+    if trainer.train_state is not None:
+        params = jax.device_get(trainer.train_state.params)
+    elif getattr(trainer, "train_state_dict", None) is not None:
+        params = trainer.train_state_dict["params"]
+    else:
+        raise ValueError("trainer holds no trained state — fit first")
+    leaves = jax.tree_util.tree_leaves(params)
+    return np.concatenate([np.ravel(np.asarray(x, np.float64))
+                           for x in leaves])
+
+
+def assert_deterministic(module_factory: Callable[[], Any],
+                         trainer_factory: Callable[[], Any],
+                         rtol: float = 0.0, atol: float = 0.0,
+                         datamodule_factory: Optional[Callable] = None
+                         ) -> np.ndarray:
+    """Fit twice from fresh modules/trainers; assert identical params.
+
+    Factories must build the run from scratch (a reused module or trainer
+    would share state and defeat the check). Default tolerance is EXACT
+    (rtol=atol=0) — same seed, same mesh, same XLA program must produce
+    bit-identical results; loosen only when comparing across layouts.
+    Returns the fingerprint so callers can also compare across configs.
+    """
+    prints = []
+    for _ in range(2):
+        trainer = trainer_factory()
+        if trainer.seed is None:
+            raise ValueError(
+                "assert_deterministic needs Trainer(seed=...) — an "
+                "unseeded run is allowed to differ from itself")
+        dm = datamodule_factory() if datamodule_factory else None
+        trainer.fit(module_factory(), datamodule=dm)
+        prints.append(fit_fingerprint(trainer))
+    if rtol == 0.0 and atol == 0.0:
+        if prints[0].shape != prints[1].shape:
+            raise AssertionError(
+                f"two same-seed fits diverged: parameter count changed "
+                f"({prints[0].size} vs {prints[1].size} elements) — the "
+                "model shape itself depends on host state (e.g. a "
+                "feature dim read from unseeded data)")
+        # equal_nan: identical NaN patterns ARE deterministic (a NaN loss
+        # is a training problem, not a determinism failure)
+        if not np.array_equal(prints[0], prints[1], equal_nan=True):
+            diff = np.abs(prints[0] - prints[1])
+            raise AssertionError(
+                f"two same-seed fits diverged: "
+                f"max|Δ|={np.nanmax(diff):.3e} "
+                f"over {int(np.count_nonzero(diff))}/{diff.size} "
+                "elements — host-side state is leaking into training "
+                "(unseeded RNG, order-dependent data loading, or "
+                "stateful module attributes)")
+    else:
+        np.testing.assert_allclose(prints[0], prints[1], rtol=rtol,
+                                   atol=atol)
+    return prints[0]

@@ -467,3 +467,39 @@ def test_val_check_interval_unsized_loader_raises(tmp_root):
                           num_sanity_val_steps=0, val_check_interval=0.5)
     with pytest.raises(ValueError, match="sized train dataloader"):
         trainer.fit(Unsized())
+
+
+def test_assert_deterministic_passes_and_catches_leaks(tmp_root):
+    """Same-seed fits are bit-identical; a module leaking unseeded host
+    randomness is caught with a diagnostic (SURVEY.md §5 determinism)."""
+    import os
+
+    from ray_lightning_tpu.testing import assert_deterministic
+
+    def trainer_factory():
+        return Trainer(strategy=RayStrategy(num_workers=2), max_epochs=1,
+                       limit_train_batches=3, limit_val_batches=0,
+                       enable_checkpointing=False, seed=7,
+                       default_root_dir=tmp_root)
+
+    fp = assert_deterministic(BoringModel, trainer_factory)
+    assert fp.size > 0
+
+    class LeakyModel(BoringModel):
+        def _data(self):
+            # unseeded: different data every run — the leak class the
+            # checker exists to catch
+            return np.random.default_rng(
+                int.from_bytes(os.urandom(4), "little")).standard_normal(
+                (self.num_samples, 32)).astype(np.float32)
+
+    with pytest.raises(AssertionError, match="same-seed fits diverged"):
+        assert_deterministic(LeakyModel, trainer_factory)
+
+    def unseeded():
+        return Trainer(strategy=RayStrategy(num_workers=2), max_epochs=1,
+                       limit_train_batches=1, enable_checkpointing=False,
+                       default_root_dir=tmp_root)
+
+    with pytest.raises(ValueError, match="seed"):
+        assert_deterministic(BoringModel, unseeded)
