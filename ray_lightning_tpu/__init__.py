@@ -24,7 +24,7 @@ from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
                                     EpochStatsCallback, seed_everything)
 from ray_lightning_tpu.launchers import RayLauncher, LocalLauncher
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "RayStrategy", "DataParallelStrategy", "RayShardedStrategy",

@@ -4,7 +4,7 @@ from setuptools import find_packages, setup
 setup(
     name="ray_lightning_tpu",
     packages=find_packages(where=".", include="ray_lightning_tpu*"),
-    version="0.1.0",
+    version="0.2.0",
     author="",
     description="TPU-native distributed training strategies with a "
                 "Ray-launchable SPMD trainer (jax/XLA/pallas)",
