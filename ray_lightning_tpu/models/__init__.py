@@ -1,7 +1,8 @@
 from ray_lightning_tpu.models.boring import BoringModel, XORModel, XORDataModule
 from ray_lightning_tpu.models.mnist import (LightningMNISTClassifier,
                                             MNISTClassifier)
-from ray_lightning_tpu.models.transformer import (TransformerConfig,
+from ray_lightning_tpu.models.transformer import (tensor_parallel_rule,
+                                                  TransformerConfig,
                                                   TransformerLM,
                                                   TransformerEncoder)
 from ray_lightning_tpu.models.gpt import GPTModule, gpt2_config, count_params
@@ -25,5 +26,5 @@ __all__ = [
     "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
     "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config",
-    "generate", "sample_logits"
+    "generate", "sample_logits", "tensor_parallel_rule"
 ]

@@ -176,13 +176,22 @@ def test_generate_and_ema_on_real_chip(tmp_path):
                         max_new_tokens=8, rng=jax.random.PRNGKey(0),
                         temperature=0.0)
         toks = np.asarray(toks)
+        # EMA must actually LAG the raw params (decay 0.9 over a short
+        # fit), not merely exist — on_train_start initializes it even if
+        # updates never fire
+        import jax as _jax
+        lag = max(
+            float(abs(np.asarray(e) - np.asarray(p)).max())
+            for e, p in zip(
+                _jax.tree_util.tree_leaves(ema.ema_params),
+                _jax.tree_util.tree_leaves(trainer.train_state.params)))
         print(json.dumps({{
             "platform": jax.devices()[0].platform,
             "shape": list(toks.shape),
             "prompt_kept": bool((toks[:, :3] == prompt).all()),
-            "ema_tracked": ema.ema_params is not None,
+            "ema_lags_params": lag > 0.0,
         }}))
     """)
     assert out["platform"] == "tpu"
     assert out["shape"] == [1, 11]
-    assert out["prompt_kept"] and out["ema_tracked"]
+    assert out["prompt_kept"] and out["ema_lags_params"]
