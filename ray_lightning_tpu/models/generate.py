@@ -83,6 +83,8 @@ def generate(model, params, prompt_tokens: jax.Array,
         logits, updated = model.apply(
             {"params": params, "cache": cache}, cur, positions=pos,
             deterministic=True, mutable=["cache"])
+        if isinstance(logits, tuple):  # MoE LMs return (logits, aux_loss)
+            logits = logits[0]
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
         # teacher-force the prompt: the sampled token only lands past it

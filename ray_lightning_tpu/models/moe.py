@@ -155,13 +155,14 @@ class MoeTransformerLM(nn.Module):
     cfg: MoeConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True, positions=None):
         cfg = self.cfg
         B, T = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wte")
         x = wte(tokens)
-        pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        pos = positions if positions is not None else \
+            jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wpe")(pos)
         aux_total = 0.0

@@ -160,3 +160,37 @@ def test_moe_composes_with_tensor_parallelism(tmp_root):
             assert spec[-2] == "tp", (names, spec)
             tp_hits += 1
     assert ep_hits >= 4 and tp_hits >= 2
+
+
+def test_moe_generate_kv_cache_matches_naive():
+    """MoE decode matches full-recompute greedy at overflow-free capacity.
+
+    capacity_factor is set so no expert can overflow in either path:
+    expert capacity scales with the forward pass's token count, so a
+    FULL-sequence pass may drop overflow tokens that single-token decode
+    (capacity computed per step) would route — only with headroom for
+    every token is cached-vs-naive equality an invariant rather than a
+    seed-dependent coincidence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models import MoeTransformerLM, moe_config
+    from ray_lightning_tpu.models.generate import generate
+
+    # capacity >= all tokens on one expert: n_experts * factor >= N
+    mk = dict(vocab_size=64, max_seq_len=16, dtype=jnp.float32,
+              capacity_factor=float(16))
+    model = MoeTransformerLM(moe_config("nano", **mk))
+    dec = MoeTransformerLM(moe_config("nano", decode=True, **mk))
+    prompt = np.array([[3, 9]], dtype=np.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    out = generate(dec, params, prompt, max_new_tokens=5,
+                   rng=jax.random.PRNGKey(1), temperature=0.0)
+    toks = prompt.copy()
+    for _ in range(5):
+        logits, _aux = model.apply({"params": params}, jnp.asarray(toks))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1), dtype=np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    assert np.array_equal(np.asarray(out), toks)

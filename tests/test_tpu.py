@@ -179,12 +179,11 @@ def test_generate_and_ema_on_real_chip(tmp_path):
         # EMA must actually LAG the raw params (decay 0.9 over a short
         # fit), not merely exist — on_train_start initializes it even if
         # updates never fire
-        import jax as _jax
         lag = max(
             float(abs(np.asarray(e) - np.asarray(p)).max())
             for e, p in zip(
-                _jax.tree_util.tree_leaves(ema.ema_params),
-                _jax.tree_util.tree_leaves(trainer.train_state.params)))
+                jax.tree_util.tree_leaves(ema.ema_params),
+                jax.tree_util.tree_leaves(trainer.train_state.params)))
         print(json.dumps({{
             "platform": jax.devices()[0].platform,
             "shape": list(toks.shape),
