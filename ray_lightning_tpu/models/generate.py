@@ -46,15 +46,24 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
 
 @partial(jax.jit,
          static_argnames=("model", "max_new_tokens", "temperature",
-                          "top_k"))
+                          "top_k", "eos_id"))
 def generate(model, params, prompt_tokens: jax.Array,
              max_new_tokens: int, rng: jax.Array,
              temperature: float = 1.0,
-             top_k: Optional[int] = None) -> jax.Array:
+             top_k: Optional[int] = None,
+             prompt_lengths: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None) -> jax.Array:
     """Generate ``max_new_tokens`` past ``prompt_tokens`` (B, P).
 
     Returns (B, P + max_new_tokens) int32. ``model.cfg.decode`` must be
     True and ``cfg.max_seq_len >= P + max_new_tokens``.
+
+    Batched variable-length prompts: left-align each row, pad the tail to
+    a common P (pad values are never read), and pass ``prompt_lengths``
+    (B,) — row *i* starts sampling at position ``prompt_lengths[i]``, so
+    no padding ever enters the cache and no attention mask is needed.
+    ``eos_id`` stops a row once sampled: every later position repeats the
+    eos token (the scan still runs full length — static shapes).
     """
     cfg = model.cfg
     if not cfg.decode:
@@ -67,6 +76,8 @@ def generate(model, params, prompt_tokens: jax.Array,
         raise ValueError(
             f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_seq_len ({cfg.max_seq_len})")
+    lengths = (jnp.full((B,), P, jnp.int32) if prompt_lengths is None
+               else jnp.asarray(prompt_lengths, jnp.int32))
 
     cache = model.init(jax.random.PRNGKey(0),
                        jnp.zeros((B, 1), jnp.int32),
@@ -75,9 +86,10 @@ def generate(model, params, prompt_tokens: jax.Array,
     tokens0 = jnp.concatenate(
         [prompt_tokens.astype(jnp.int32),
          jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
+    done0 = jnp.zeros((B,), jnp.bool_)
 
     def step(carry, t):
-        cache, tokens, rng = carry
+        cache, tokens, rng, done = carry
         cur = jax.lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)
         pos = jnp.full((B, 1), t, jnp.int32)
         logits, updated = model.apply(
@@ -87,12 +99,19 @@ def generate(model, params, prompt_tokens: jax.Array,
             logits = logits[0]
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
-        # teacher-force the prompt: the sampled token only lands past it
-        forced = jnp.where(t + 1 < P, tokens[:, t + 1], nxt)
+        if eos_id is not None:
+            # done can only be set while a row is actually GENERATING —
+            # throwaway samples during another row's teacher-forced
+            # prompt region must not latch it
+            generating = (t + 1) >= lengths
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (generating & (nxt == eos_id))
+        # teacher-force each row's own prompt; sampling starts at its end
+        forced = jnp.where(t + 1 < lengths, tokens[:, t + 1], nxt)
         tokens = jax.lax.dynamic_update_slice_in_dim(
             tokens, forced[:, None], t + 1, axis=1)
-        return (updated["cache"], tokens, rng), None
+        return (updated["cache"], tokens, rng, done), None
 
-    (cache, tokens, rng), _ = jax.lax.scan(
-        step, (cache, tokens0, rng), jnp.arange(total - 1))
+    (cache, tokens, rng, _done), _ = jax.lax.scan(
+        step, (cache, tokens0, rng, done0), jnp.arange(total - 1))
     return tokens

@@ -267,3 +267,65 @@ def test_generate_tensor_parallel_matches():
     out = generate(dec, sharded, prompt, max_new_tokens=6,
                    rng=jax.random.PRNGKey(1), temperature=0.0)
     assert np.array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_generate_variable_length_batch():
+    """Each row of a ragged batch must decode exactly as it would alone
+    (left-aligned prompts + prompt_lengths; no padding enters the cache)."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models import TransformerLM, gpt2_config
+    from ray_lightning_tpu.models.generate import generate
+
+    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))["params"]
+
+    p0 = np.array([5, 17, 3, 9], dtype=np.int32)        # length 4
+    p1 = np.array([42, 7], dtype=np.int32)              # length 2
+    batch = np.zeros((2, 4), np.int32)
+    batch[0, :4], batch[1, :2] = p0, p1
+    out = generate(dec, params, batch, max_new_tokens=5,
+                   rng=jax.random.PRNGKey(3), temperature=0.0,
+                   prompt_lengths=np.array([4, 2], np.int32))
+    solo0 = generate(dec, params, p0[None], max_new_tokens=5,
+                     rng=jax.random.PRNGKey(3), temperature=0.0)
+    solo1 = generate(dec, params, p1[None], max_new_tokens=5,
+                     rng=jax.random.PRNGKey(3), temperature=0.0)
+    out = np.asarray(out)
+    # row 0: full 4+5; row 1: its own 2+5 live in the first 7 positions
+    assert np.array_equal(out[0], np.asarray(solo0)[0])
+    assert np.array_equal(out[1, :7], np.asarray(solo1)[0])
+
+
+def test_generate_eos_stops_row():
+    """After a row samples eos, every later position repeats eos; a
+    prompt token equal to eos must NOT stop the row."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models import TransformerLM, gpt2_config
+    from ray_lightning_tpu.models.generate import generate
+
+    mk = dict(vocab_size=32, max_seq_len=24, dtype=jnp.float32)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((1, 3), np.int32))["params"]
+    prompt = np.array([[4, 11, 4]], dtype=np.int32)
+
+    free = np.asarray(generate(dec, params, prompt, max_new_tokens=12,
+                               rng=jax.random.PRNGKey(5),
+                               temperature=0.0))
+    # greedy without eos: find what it emits, then declare that token eos
+    emitted = free[0, 3:]
+    eos = int(emitted[0])
+    stopped = np.asarray(generate(dec, params, prompt, max_new_tokens=12,
+                                  rng=jax.random.PRNGKey(5),
+                                  temperature=0.0, eos_id=eos))
+    assert (stopped[0, 3:] == eos).all()  # first sample = eos → all eos
+    # prompt containing the eos token still decodes (prompt[0]==4 above
+    # was not treated as a stop when eos=4):
+    stopped2 = np.asarray(generate(dec, params, prompt, max_new_tokens=4,
+                                   rng=jax.random.PRNGKey(5),
+                                   temperature=0.0, eos_id=4))
+    assert stopped2.shape == (1, 7)
