@@ -167,20 +167,28 @@ def _build_bert_step(strategy, batch_size: int, seq_len: int):
 def _build_gpt2_step(strategy, batch_size: int, seq_len: int):
     """Flagship model (GPT-2-small, the ``entry()`` model) train step.
 
-    Config from the v5e sweep: bs 8 / seq 512 / bf16 / scanned layers /
-    remat(dots_with_no_batch_dims), vocab padded 50257→50304 (x128
+    Config from the round-3 v5e sweep + HLO trace: bs 8 / seq 512 / bf16 /
+    UNROLLED layers / remat(dots_with_no_batch_dims) / fused bf16-logit
+    cross-entropy (``lm_head_xent``), vocab padded 50257→50304 (x128
     multiple keeps the LM-head matmul MXU-aligned: +9% measured).
-    Sweep: bs8@512→247 sps (MFU .478), bs16→212, bs32→200, full
-    remat→184, seq1024→collapses to MFU .27 (T^2 attention).
+    Round-3 sweep (samples/s at bs8@512): scanned+f32-xent 237 → unrolled
+    248 → unrolled+fused-xent 265-279. Larger batches LOSE on this chip
+    (bs16 249, bs32 230 — the per-layer emitters degrade and the LM-head
+    adamw fusion doubles); no-remat and policy 'dots' both lose to
+    dots_nb (saved-activation HBM traffic > recompute). Flash attention
+    loses to XLA dot inside the step at T=512 (kernel opacity blocks
+    neighboring fusions) while winning standalone — measured, not
+    assumed.
     """
     import jax.numpy as jnp
     import optax
 
     from ray_lightning_tpu.models.gpt import gpt2_config
     from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.ops.lm_head_loss import lm_head_xent
 
     cfg = gpt2_config("small", vocab_size=50304, max_seq_len=seq_len,
-                      dtype=jnp.bfloat16, scan_layers=True, remat=True,
+                      dtype=jnp.bfloat16, scan_layers=False, remat=True,
                       remat_policy="dots_with_no_batch_dims")
     model = TransformerLM(cfg)
     tx = optax.adamw(3e-4, weight_decay=0.1)
@@ -189,9 +197,8 @@ def _build_gpt2_step(strategy, batch_size: int, seq_len: int):
 
     def loss_fn(params, model_state, batch, rng):
         x, y = batch[:, :-1], batch[:, 1:]
-        logits = model.apply({"params": params}, x)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
+        hidden = model.apply({"params": params}, x, return_hidden=True)
+        loss = lm_head_xent(hidden, params["wte"]["embedding"], y)
         return loss, ({}, model_state)
 
     return _assemble_step(strategy, model, tx, loss_fn, toks[:1, :-1],
@@ -592,7 +599,7 @@ def main() -> None:
         gpt_bs, gpt_seq = 8, 512
         gpt = bench_model(_build_gpt2_step, samples_per_step=gpt_bs,
                           analytic_tokens=gpt_bs * gpt_seq,
-                          batch_size=gpt_bs, seq_len=gpt_seq, best_of=2)
+                          batch_size=gpt_bs, seq_len=gpt_seq, best_of=3)
         extras["gpt2_small"] = {
             "samples_per_sec_per_chip": round(
                 gpt["samples_per_sec_per_chip"], 2),

@@ -1,0 +1,99 @@
+"""Chunked / fused LM-head cross-entropy vs the direct optax computation.
+
+The ops exist for TPU memory/traffic reasons (see ops/lm_head_loss.py);
+these tests pin their *math* to the obvious formulation on CPU: identical
+loss and gradients (hidden and embedding) at f32, padding correctness when
+the token count does not divide the chunk, and the z-loss term.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_lightning_tpu.ops.lm_head_loss import (chunked_lm_head_xent,
+                                                lm_head_xent)
+
+B, T, D, V = 2, 9, 16, 37
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    hidden = jnp.asarray(rng.standard_normal((B, T, D)), jnp.float32)
+    emb = jnp.asarray(rng.standard_normal((V, D)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (B, T)), jnp.int32)
+    return hidden, emb, labels
+
+
+def direct_loss(hidden, emb, labels):
+    logits = hidden.reshape(-1, D) @ emb.T
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels.reshape(-1)).mean()
+
+
+@pytest.mark.parametrize("chunk", [4, 6, 18, 999])
+def test_chunked_matches_direct(data, chunk):
+    hidden, emb, labels = data
+    got = chunked_lm_head_xent(hidden, emb, labels, chunk_size=chunk,
+                               compute_dtype=jnp.float32)
+    want = direct_loss(hidden, emb, labels)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [4, 18])
+def test_chunked_grads_match_direct(data, chunk):
+    hidden, emb, labels = data
+
+    def f_chunked(h, e):
+        return chunked_lm_head_xent(h, e, labels, chunk_size=chunk,
+                                    compute_dtype=jnp.float32)
+
+    def f_direct(h, e):
+        return direct_loss(h, e, labels)
+
+    gh_c, ge_c = jax.grad(f_chunked, argnums=(0, 1))(hidden, emb)
+    gh_d, ge_d = jax.grad(f_direct, argnums=(0, 1))(hidden, emb)
+    np.testing.assert_allclose(gh_c, gh_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ge_c, ge_d, rtol=1e-5, atol=1e-6)
+
+
+def test_direct_fused_matches_optax(data):
+    hidden, emb, labels = data
+    got = lm_head_xent(hidden, emb, labels, compute_dtype=jnp.float32)
+    want = direct_loss(hidden, emb, labels)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def f_fused(h, e):
+        return lm_head_xent(h, e, labels, compute_dtype=jnp.float32)
+
+    gh, ge = jax.grad(f_fused, argnums=(0, 1))(hidden, emb)
+    gh_d, ge_d = jax.grad(
+        lambda h, e: direct_loss(h, e, labels), argnums=(0, 1))(hidden, emb)
+    np.testing.assert_allclose(gh, gh_d, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ge, ge_d, rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_compute_close_to_f32(data):
+    hidden, emb, labels = data
+    got = lm_head_xent(hidden, emb, labels)  # bf16 matmul path
+    want = direct_loss(hidden, emb, labels)
+    # bf16 logits: loose tolerance, but the reductions accumulate in f32
+    np.testing.assert_allclose(got, want, rtol=0.05)
+
+
+def test_z_loss_positive_and_additive(data):
+    hidden, emb, labels = data
+    base = chunked_lm_head_xent(hidden, emb, labels, chunk_size=6,
+                                compute_dtype=jnp.float32)
+    with_z = chunked_lm_head_xent(hidden, emb, labels, chunk_size=6,
+                                  compute_dtype=jnp.float32, z_loss=1e-2)
+    assert float(with_z) > float(base)
+
+
+def test_flat_input_shapes(data):
+    hidden, emb, labels = data
+    flat = lm_head_xent(hidden.reshape(-1, D), emb, labels.reshape(-1),
+                        compute_dtype=jnp.float32)
+    batched = lm_head_xent(hidden, emb, labels, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(flat, batched, rtol=1e-7)
