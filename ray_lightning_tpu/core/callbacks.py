@@ -211,9 +211,17 @@ class ModelCheckpoint(Callback):
                     # committed (AsyncCheckpointer serializes saves), but
                     # the save issued THIS call can itself be the worst
                     # and get pruned immediately — wait for that one case
-                    # instead of serializing every epoch
+                    # instead of serializing every epoch. Multi-process
+                    # orbax saves are collective: this process's local
+                    # serialization order says nothing about the other
+                    # hosts' commit progress, so there rank 0 must drain
+                    # its async queue before deleting any directory
+                    # (ADVICE round 2: never rmtree across an unobserved
+                    # commit barrier).
                     import shutil
-                    if self.async_save and path == self._last_saved_path:
+                    if self.async_save and (
+                            path == self._last_saved_path
+                            or jax.process_count() > 1):
                         from ray_lightning_tpu.core.checkpoint import \
                             wait_for_async_saves
                         wait_for_async_saves()

@@ -522,8 +522,11 @@ class Trainer:
                                 if np.isscalar(v))
                 print(f"epoch {epoch}: {msg} ({dt:.1f}s)")
 
+            # `self.should_stop` too: a mid-epoch interval validation may
+            # have tripped EarlyStopping after the batch loop broke —
+            # epoch-end validation must not run after a requested stop
             run_epoch_val = val_loader is not None and not stop and \
-                epoch_validates
+                not self.should_stop and epoch_validates
             if val_every:
                 # interval mode owns validation; the epoch boundary only
                 # adds one for a float interval that doesn't divide the

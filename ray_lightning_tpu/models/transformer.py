@@ -11,7 +11,8 @@ TPU-first choices baked in:
   trading MXU FLOPs for HBM — the standard memory lever for long sequences.
 - **Pluggable attention impl** (``attention_impl``): 'dot' (XLA-fused
   reference), 'flash' (pallas blockwise kernel), 'ring' (sequence-parallel
-  ring attention over the ``sp`` mesh axis).
+  ring attention over the ``sp`` mesh axis), 'ulysses' (all-to-all
+  head-sharded sequence parallelism over the same axis).
 
 Parameter-path naming is stable and load-bearing: tensor-parallel sharding
 rules (``MeshStrategy(param_rule=...)``) match on these names.
@@ -50,7 +51,7 @@ class TransformerConfig:
     # max_seq_len in the "cache" variable collection and consumes ONE
     # token per call (see models/generate.py)
     decode: bool = False
-    attention_impl: str = "dot"      # dot | flash | ring
+    attention_impl: str = "dot"      # dot | flash | ring | ulysses
     tie_embeddings: bool = True
     num_segments: int = 0            # >0 adds segment embeddings (BERT)
 
@@ -125,6 +126,9 @@ def _attention_fn(cfg: TransformerConfig):
         from ray_lightning_tpu.parallel.ring_attention import (
             sp_sharded_attention)
         return sp_sharded_attention
+    if cfg.attention_impl == "ulysses":
+        from ray_lightning_tpu.parallel.ulysses import ulysses_attention
+        return ulysses_attention
     raise ValueError(f"Unknown attention_impl {cfg.attention_impl!r}")
 
 

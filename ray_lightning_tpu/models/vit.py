@@ -30,8 +30,14 @@ def vit_config(size: str = "tiny", image_size: int = 32,
         "small": (12, 384, 6),
         "base": (12, 768, 12),   # ViT-B
     }
+    if size not in sizes:
+        raise ValueError(f"Unknown ViT size {size!r}; choose from "
+                         f"{sorted(sizes)}")
     n_layers, d_model, n_heads = sizes[size]
-    assert image_size % patch_size == 0
+    if image_size % patch_size != 0:
+        raise ValueError(
+            f"image_size={image_size} must be divisible by "
+            f"patch_size={patch_size} (non-overlapping square patches)")
     n_patches = (image_size // patch_size) ** 2
     base = dict(vocab_size=1,  # unused: inputs are pixels, not tokens
                 max_seq_len=n_patches + 1,  # +1 CLS

@@ -1,0 +1,87 @@
+"""Ulysses-style sequence parallelism: all-to-all head-sharded attention.
+
+The second long-context axis (DeepSpeed-Ulysses / megascale "context
+parallelism by heads"), complementing ring attention
+(:mod:`ray_lightning_tpu.parallel.ring_attention`):
+
+- **ring**: K/V shards rotate around the ``sp`` ring (``ppermute``), each
+  rank computes online-softmax partials for its *local queries*. Memory
+  O(T/N) everywhere; N neighbor hops per attention; causal masking skips
+  half the hops' work.
+- **ulysses**: one all-to-all reshards activations from sequence-sharded
+  ``(B, T/N, H, D)`` to head-sharded ``(B, T, H/N, D)``, each rank runs
+  *full-sequence attention for its head subset*, and one all-to-all
+  reshards back. Two collective hops total (cheaper than N ppermute hops
+  when N is large and ICI all-to-all is fast), and — because every rank
+  sees the whole sequence — arbitrary additive masks and attention
+  dropout work unchanged, which the ring's blockwise accumulator cannot
+  cheaply support.
+
+TPU-native design: no explicit ``all_to_all`` calls. The arrays are
+logically global under GSPMD; two ``with_sharding_constraint`` boundary
+annotations (sequence-sharded → head-sharded → sequence-sharded) make XLA
+insert the minimal resharding collectives over ICI. The rest of the model
+keeps the sequence-sharded layout from ``SequenceParallelStrategy``
+(LN/MLP are pointwise over tokens, so they stay perfectly sharded).
+
+Constraint: ``n_heads`` must be divisible by ``sp`` (checked at trace
+time, static shapes). The reference has no counterpart (SURVEY.md §2.3
+"Ulysses: absent — not required"); this closes that inventory row anyway.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.ops.attention import dot_product_attention
+from ray_lightning_tpu.parallel.ring_attention import SP_AXIS_NAME, \
+    get_sp_mesh
+
+
+def _spec(mesh, *entries):
+    names = mesh.axis_names
+    return NamedSharding(
+        mesh, P(*[e if e is None or e in names else None for e in entries]))
+
+
+def ulysses_attention(q: jax.Array,
+                      k: jax.Array,
+                      v: jax.Array,
+                      *,
+                      causal: bool = False,
+                      mask: Optional[jax.Array] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+    """Attention with Ulysses sequence parallelism over the ``sp`` axis.
+
+    Shapes ``(B, T, H, D)`` (global, GSPMD). With no ``sp`` mesh
+    registered this is exactly :func:`dot_product_attention`, so models
+    can set ``attention_impl='ulysses'`` unconditionally.
+    """
+    mesh = get_sp_mesh()
+    if mesh is None:
+        return dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                     dropout_rate=dropout_rate,
+                                     dropout_rng=dropout_rng)
+    sp = mesh.shape[SP_AXIS_NAME]
+    n_heads = q.shape[2]
+    if n_heads % sp != 0:
+        raise ValueError(
+            f"ulysses attention shards heads over sp={sp}, but n_heads="
+            f"{n_heads} is not divisible; use a head count divisible by "
+            "sp or attention_impl='ring' (which shards sequence, not "
+            "heads)")
+
+    seq_spec = _spec(mesh, "dp", SP_AXIS_NAME, None, None)
+    head_spec = _spec(mesh, "dp", None, SP_AXIS_NAME, None)
+
+    # boundary 1: sequence-sharded -> head-sharded (XLA emits all-to-all)
+    q, k, v = (jax.lax.with_sharding_constraint(x, head_spec)
+               for x in (q, k, v))
+    out = dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                dropout_rate=dropout_rate,
+                                dropout_rng=dropout_rng)
+    # boundary 2: back to the model's sequence-sharded layout
+    return jax.lax.with_sharding_constraint(out, seq_spec)

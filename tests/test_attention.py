@@ -98,15 +98,15 @@ def test_gpt_with_flash_attention(tmp_path):
     from ray_lightning_tpu import RayStrategy, Trainer
     from ray_lightning_tpu.models.gpt import GPTModule, gpt2_config
 
-    cfg = gpt2_config("nano", vocab_size=256, max_seq_len=64,
+    cfg = gpt2_config("nano", vocab_size=256, max_seq_len=32,
                       attention_impl="flash")
-    model = GPTModule(config=cfg, batch_size=8, seq_len=64, num_samples=64,
+    model = GPTModule(config=cfg, batch_size=4, seq_len=32, num_samples=16,
                       lr=1e-3)
     trainer = Trainer(strategy=RayStrategy(num_workers=2), max_epochs=1,
-                      limit_train_batches=4, limit_val_batches=2,
+                      limit_train_batches=2, limit_val_batches=1,
                       default_root_dir=str(tmp_path))
     trainer.fit(model)
-    assert trainer.global_step == 4
+    assert trainer.global_step == 2
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -35,50 +35,87 @@ def test_gpt_fsdp_sharded_params(tmp_root):
 
 
 def test_gpt_scan_vs_loop_equivalent(tmp_root):
-    """nn.scan over layers must be numerically identical to the python loop."""
-    def run(scan_layers):
-        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=64,
-                          scan_layers=scan_layers)
-        model = GPTModule(config=cfg, batch_size=4, seq_len=64,
-                          num_samples=32, lr=1e-3)
-        trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=1),
-                              max_epochs=1, limit_train_batches=2,
-                              limit_val_batches=1, checkpoint_callback=False,
-                              seed=0)
-        trainer.fit(model)
-        return float(trainer.callback_metrics["val_loss"])
+    """nn.scan over layers must be numerically identical to the python
+    loop: the SAME weights (scanned stack unstacked into per-block trees)
+    must produce the same logits exactly. (The previous form compared
+    trained val-losses of independently-initialized fits to within 1.0 —
+    weaker and 2 trainer compiles slower.)"""
+    from ray_lightning_tpu.models import TransformerLM
 
-    # params init differs between layouts (per-layer rng split), so compare
-    # learned-loss magnitude rather than exact params
-    l_scan, l_loop = run(True), run(False)
-    assert abs(l_scan - l_loop) < 1.0
+    import jax.numpy as jnp
+
+    toks = np.asarray(
+        np.random.default_rng(0).integers(0, 256, size=(2, 16)), np.int32)
+    # f32: in bf16 the two layouts reassociate reductions differently and
+    # drift ~1e-2 — layout equivalence is only exact at full precision
+    cfg_scan = gpt2_config("nano", vocab_size=256, max_seq_len=16,
+                           scan_layers=True, dtype=jnp.float32)
+    cfg_loop = gpt2_config("nano", vocab_size=256, max_seq_len=16,
+                           scan_layers=False, dtype=jnp.float32)
+    scan_model, loop_model = TransformerLM(cfg_scan), TransformerLM(cfg_loop)
+    params = scan_model.init(jax.random.PRNGKey(0), toks)["params"]
+
+    # unstack the scanned {"stack": {"layers": {"block": leaves[L, ...]}}}
+    # into the loop layout {"stack": {"block_i": leaves[...]}}
+    loop_params = {k: v for k, v in params.items() if k != "stack"}
+    stacked = params["stack"]["layers"]["block"]
+    loop_params["stack"] = {
+        f"block_{i}": jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        for i in range(cfg_loop.n_layers)
+    }
+
+    out_scan = scan_model.apply({"params": params}, toks)
+    out_loop = loop_model.apply({"params": loop_params}, toks)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_gpt_remat_matches(tmp_root):
-    """Remat (any policy) changes memory, not math."""
-    def run(remat, policy=None):
-        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=32,
-                          remat=remat, remat_policy=policy)
-        model = GPTModule(config=cfg, batch_size=4, seq_len=32,
-                          num_samples=32, lr=1e-3)
-        trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
-                              max_epochs=1, limit_train_batches=3,
-                              limit_val_batches=0, checkpoint_callback=False,
-                              seed=1)
-        trainer.fit(model)
-        return jax.device_get(trainer.train_state.params)
+    """Remat (any policy, scanned and unrolled) changes memory, not math.
 
-    p_base = run(False)
-    for policy in (None, "dots", "dots_with_no_batch_dims"):
-        p_remat = run(True, policy)
-        for a, b in zip(jax.tree_util.tree_leaves(p_base),
-                        jax.tree_util.tree_leaves(p_remat)):
-            np.testing.assert_allclose(np.asarray(a, np.float32),
-                                       np.asarray(b, np.float32),
-                                       rtol=2e-3, atol=2e-4)
+    Compares loss gradients directly (the full-fit variant of this test
+    cost 4 trainer compiles ≈ 43s — round-2 VERDICT suite-runtime item;
+    the grad comparison exercises the same nn.remat machinery).
+    """
+    import optax
+
+    from ray_lightning_tpu.models.transformer import TransformerLM
+
+    toks = np.asarray(
+        np.random.default_rng(1).integers(0, 256, size=(4, 33)), np.int32)
+
+    def grads(remat, policy=None, scan=True):
+        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=32,
+                          remat=remat, remat_policy=policy,
+                          scan_layers=scan)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0), toks[:, :-1])["params"]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, toks[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, toks[:, 1:]).mean()
+
+        return jax.device_get(jax.grad(loss_fn)(params))
+
+    # param trees differ between scan (stacked) and unrolled (per-block),
+    # so each layout compares against its own no-remat base. "dots" sits
+    # between the two policies tested (its callable is jax's own); a trace
+    # per case is ~6s on CPU, so the matrix stays minimal.
+    cases = [(True, (None, "dots_with_no_batch_dims")),
+             (False, ("dots_with_no_batch_dims",))]  # the bench config
+    for scan, policies in cases:
+        g_base = grads(False, scan=scan)
+        for policy in policies:
+            g_remat = grads(True, policy, scan)
+            for a, b in zip(jax.tree_util.tree_leaves(g_base),
+                            jax.tree_util.tree_leaves(g_remat)):
+                np.testing.assert_allclose(np.asarray(a, np.float32),
+                                           np.asarray(b, np.float32),
+                                           rtol=2e-3, atol=2e-4)
 
     with pytest.raises(ValueError, match="remat_policy"):
-        run(True, "bogus")
+        grads(True, "bogus")
 
 
 def test_gpt2_param_counts():
@@ -97,9 +134,9 @@ def test_gpt2_param_counts():
 
 def test_bert_trains(tmp_root):
     model = BertModule(size="tiny", batch_size=16, seq_len=64,
-                       num_samples=512, lr=1e-3)
+                       num_samples=256, lr=2e-3)
     trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
-                          max_epochs=4, limit_train_batches=32,
+                          max_epochs=3, limit_train_batches=16,
                           limit_val_batches=4, checkpoint_callback=False)
     trainer.fit(model)
     assert float(trainer.callback_metrics["val_acc"]) > 0.7
@@ -119,11 +156,11 @@ def test_bert_sharded(tmp_root):
 def test_resnet18_batchstats_update(tmp_root):
     """BatchNorm running stats must actually move through the
     (loss, logs, mutated_state) training_step path."""
-    model = ResNetModule(depth=18, batch_size=16, num_samples=128,
+    model = ResNetModule(depth=18, batch_size=8, num_samples=32,
                          lr=0.05)
     trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
-                          max_epochs=1, limit_train_batches=6,
-                          limit_val_batches=2, checkpoint_callback=False)
+                          max_epochs=1, limit_train_batches=2,
+                          limit_val_batches=0, checkpoint_callback=False)
     trainer.fit(model)
     bs = trainer.train_state.model_state.get("batch_stats")
     assert bs is not None
@@ -133,9 +170,9 @@ def test_resnet18_batchstats_update(tmp_root):
 
 
 def test_resnet_learns(tmp_root):
-    model = ResNetModule(depth=18, batch_size=16, num_samples=256, lr=0.05)
+    model = ResNetModule(depth=18, batch_size=16, num_samples=128, lr=0.05)
     trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
-                          max_epochs=3, limit_train_batches=16,
+                          max_epochs=2, limit_train_batches=8,
                           limit_val_batches=4, checkpoint_callback=False)
     trainer.fit(model)
     assert float(trainer.callback_metrics["val_acc"]) > 0.5
@@ -145,9 +182,9 @@ def test_vit_learns(tmp_root):
     from ray_lightning_tpu.models import ViTModule
 
     model = ViTModule(size="tiny", image_size=16, patch_size=4,
-                      batch_size=32, num_samples=256, lr=1e-3)
+                      batch_size=32, num_samples=256, lr=2e-3)
     trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
-                          max_epochs=3, limit_train_batches=8,
+                          max_epochs=2, limit_train_batches=8,
                           limit_val_batches=4, checkpoint_callback=False)
     trainer.fit(model)
     acc = float(trainer.callback_metrics["val_acc"])
@@ -168,12 +205,12 @@ def test_vit_fsdp_and_tp(tmp_root):
                      MeshStrategy(axes={"dp": 2, "tp": 2},
                                   param_rule=tensor_parallel_rule)):
         model = ViTModule(image_size=16, patch_size=4,
-                          batch_size=16, num_samples=64, config=cfg)
+                          batch_size=16, num_samples=16, config=cfg)
         trainer = get_trainer(tmp_root, strategy=strategy, max_epochs=1,
-                              limit_train_batches=2, limit_val_batches=0,
+                              limit_train_batches=1, limit_val_batches=0,
                               checkpoint_callback=False)
         trainer.fit(model)
-        assert trainer.global_step == 2
+        assert trainer.global_step == 1
 
 
 def test_generate_kv_cache_matches_naive_greedy():
@@ -196,10 +233,10 @@ def test_generate_kv_cache_matches_naive_greedy():
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
 
     out = generate(TransformerLM(dec_cfg), params, jnp.asarray(prompt),
-                   max_new_tokens=6, rng=jax.random.PRNGKey(1),
+                   max_new_tokens=4, rng=jax.random.PRNGKey(1),
                    temperature=0.0)
     toks = prompt.copy()
-    for _ in range(6):
+    for _ in range(4):  # each naive iteration is a fresh compile (T grows)
         logits = model.apply({"params": params}, jnp.asarray(toks))
         nxt = np.asarray(jnp.argmax(logits[:, -1], -1), dtype=np.int32)
         toks = np.concatenate([toks, nxt[:, None]], axis=1)

@@ -186,10 +186,10 @@ def test_moe_generate_kv_cache_matches_naive():
     prompt = np.array([[3, 9]], dtype=np.int32)
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
 
-    out = generate(dec, params, prompt, max_new_tokens=5,
+    out = generate(dec, params, prompt, max_new_tokens=4,
                    rng=jax.random.PRNGKey(1), temperature=0.0)
     toks = prompt.copy()
-    for _ in range(5):
+    for _ in range(4):
         logits, _aux = model.apply({"params": params}, jnp.asarray(toks))
         nxt = np.asarray(jnp.argmax(logits[:, -1], -1), dtype=np.int32)
         toks = np.concatenate([toks, nxt[:, None]], axis=1)
