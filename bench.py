@@ -188,9 +188,21 @@ def _build_gpt2_step(strategy, batch_size: int, seq_len: int,
     from ray_lightning_tpu.models.transformer import TransformerLM
     from ray_lightning_tpu.ops.lm_head_loss import lm_head_xent
 
+    # small fits comfortably: unrolled layers + direct fused xent is the
+    # measured optimum. medium (355M) only fits the 16 GB chip with
+    # scanned layers + the chunked loss (unrolled OOMs even at full
+    # remat; direct loss OOMs) — single-chip medium is memory-bound by
+    # design; BASELINE's medium config is multi-host FSDP (v4-32).
+    scan = size != "small"
+    # bf16 softmax: the (B,H,T,T) score tensors dominate attention HBM
+    # traffic; storing + reducing them bf16 measured +13% on this step
+    # (300 vs 265 sps same-session). ~1% attention-weight rounding —
+    # training-quality parity pinned by test_models.py
+    # (test_bf16_softmax_training_parity).
     cfg = gpt2_config(size, vocab_size=50304, max_seq_len=seq_len,
-                      dtype=jnp.bfloat16, scan_layers=False, remat=True,
-                      remat_policy="dots_with_no_batch_dims")
+                      dtype=jnp.bfloat16, scan_layers=scan, remat=True,
+                      remat_policy="dots_with_no_batch_dims",
+                      attention_softmax_dtype=jnp.bfloat16)
     model = TransformerLM(cfg)
     tx = optax.adamw(3e-4, weight_decay=0.1)
     toks = np.random.default_rng(0).integers(
@@ -199,7 +211,14 @@ def _build_gpt2_step(strategy, batch_size: int, seq_len: int,
     def loss_fn(params, model_state, batch, rng):
         x, y = batch[:, :-1], batch[:, 1:]
         hidden = model.apply({"params": params}, x, return_hidden=True)
-        loss = lm_head_xent(hidden, params["wte"]["embedding"], y)
+        if scan:
+            from ray_lightning_tpu.ops.lm_head_loss import (
+                chunked_lm_head_xent)
+            loss = chunked_lm_head_xent(hidden,
+                                        params["wte"]["embedding"], y,
+                                        chunk_size=2048)
+        else:
+            loss = lm_head_xent(hidden, params["wte"]["embedding"], y)
         return loss, ({}, model_state)
 
     return _assemble_step(strategy, model, tx, loss_fn, toks[:1, :-1],
@@ -621,13 +640,8 @@ def main() -> None:
     except Exception as exc:  # secondary benches degrade to a diagnostic
         extras["bert_base"] = {"error": f"{type(exc).__name__}: {exc}"}
 
-    # gpt2_medium is the scale-up story: at 355M params the per-step fixed
-    # costs (optimizer tree, attention softmax, xent) amortize over 2.9x
-    # the matmul FLOPs, so MFU should sit visibly above gpt2_small's —
-    # evidence the small-model number is workload-bound, not framework-bound
-    gpt_bs, gpt_seq = 8, 512
-    for key, size, best_of in (("gpt2_small", "small", 3),
-                               ("gpt2_medium", "medium", 2)):
+    def gpt_extra(key: str, size: str, best_of: int) -> None:
+        gpt_bs, gpt_seq = 8, 512
         try:
             gpt = bench_model(_build_gpt2_step, samples_per_step=gpt_bs,
                               analytic_tokens=gpt_bs * gpt_seq,
@@ -643,6 +657,8 @@ def main() -> None:
             }
         except Exception as exc:
             extras[key] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    gpt_extra("gpt2_small", "small", 3)
 
     try:
         extras["flash_attention_t8192"] = _bench_flash_long_seq()
@@ -663,6 +679,12 @@ def main() -> None:
         }
     except Exception as exc:
         extras["batch_scaling"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    # medium (355M) brushes the 16 GB HBM ceiling by design — an OOM here
+    # poisons subsequent allocations in this backend (observed: flash +
+    # batch_scaling inherited RESOURCE_EXHAUSTED), so it runs AFTER every
+    # other on-chip section
+    gpt_extra("gpt2_medium", "medium", 2)
 
     try:
         extras["scaling"] = bench_scaling()

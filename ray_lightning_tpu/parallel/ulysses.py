@@ -53,18 +53,20 @@ def ulysses_attention(q: jax.Array,
                       causal: bool = False,
                       mask: Optional[jax.Array] = None,
                       dropout_rate: float = 0.0,
-                      dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+                      dropout_rng: Optional[jax.Array] = None,
+                      softmax_dtype=None) -> jax.Array:
     """Attention with Ulysses sequence parallelism over the ``sp`` axis.
 
     Shapes ``(B, T, H, D)`` (global, GSPMD). With no ``sp`` mesh
     registered this is exactly :func:`dot_product_attention`, so models
     can set ``attention_impl='ulysses'`` unconditionally.
     """
+    sd = {} if softmax_dtype is None else {"softmax_dtype": softmax_dtype}
     mesh = get_sp_mesh()
     if mesh is None:
         return dot_product_attention(q, k, v, causal=causal, mask=mask,
                                      dropout_rate=dropout_rate,
-                                     dropout_rng=dropout_rng)
+                                     dropout_rng=dropout_rng, **sd)
     sp = mesh.shape[SP_AXIS_NAME]
     n_heads = q.shape[2]
     if n_heads % sp != 0:
@@ -82,6 +84,6 @@ def ulysses_attention(q: jax.Array,
                for x in (q, k, v))
     out = dot_product_attention(q, k, v, causal=causal, mask=mask,
                                 dropout_rate=dropout_rate,
-                                dropout_rng=dropout_rng)
+                                dropout_rng=dropout_rng, **sd)
     # boundary 2: back to the model's sequence-sharded layout
     return jax.lax.with_sharding_constraint(out, seq_spec)

@@ -366,3 +366,49 @@ def test_generate_eos_stops_row():
                                    rng=jax.random.PRNGKey(5),
                                    temperature=0.0, eos_id=4))
     assert stopped2.shape == (1, 7)
+
+
+def test_bf16_softmax_close_to_f32():
+    """attention_softmax_dtype=bf16 (the bench's speed knob: bf16 score
+    tensors halve attention HBM traffic) must stay within ~1% of the f32
+    softmax on logits."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models import TransformerLM
+
+    toks = np.asarray(
+        np.random.default_rng(3).integers(0, 256, size=(2, 32)), np.int32)
+
+    def logits(softmax_dtype):
+        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=32,
+                          attention_softmax_dtype=softmax_dtype)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        return np.asarray(model.apply({"params": params}, toks))
+
+    a, b = logits(jnp.float32), logits(jnp.bfloat16)
+    # same params (same init rng); only the softmax precision differs
+    np.testing.assert_allclose(a, b, rtol=0.05, atol=0.05)
+
+
+def test_bf16_softmax_training_parity(tmp_root):
+    """Training quality survives the bf16 softmax: same fit on the
+    learnable synthetic stream lands within noise of the f32 run's loss
+    (guards the bench config against silently degrading into a
+    fast-but-wrong step)."""
+    import jax.numpy as jnp
+
+    def run(softmax_dtype):
+        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=64,
+                          attention_softmax_dtype=softmax_dtype)
+        model = GPTModule(config=cfg, batch_size=8, seq_len=64,
+                          num_samples=128, lr=1e-3)
+        trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                              max_epochs=2, limit_train_batches=8,
+                              limit_val_batches=2, checkpoint_callback=False,
+                              seed=5)
+        trainer.fit(model)
+        return float(trainer.callback_metrics["val_loss"])
+
+    l32, l16 = run(jnp.float32), run(jnp.bfloat16)
+    assert l16 < l32 + 0.15, (l32, l16)

@@ -202,9 +202,17 @@ def test_iter_batches_strided_sharding():
 
 
 def test_fallback_without_native(monkeypatch):
+    """No-native fallback yields the identical sequence. The path gate is
+    ``uses_ring`` (frozen at construction from ``native_available()``), so
+    simulate a library-less host by patching the availability probe BEFORE
+    construction — flipping ``loader.native`` afterwards would be ignored.
+    """
+    from ray_lightning_tpu.data import multiproc as mp_mod
+
+    monkeypatch.setattr(mp_mod, "native_available", lambda: False)
     loader = MultiprocessDataLoader(_make_loader(), num_workers=2,
                                     auto_fallback=False)
-    monkeypatch.setattr(loader, "native", False)
+    assert loader.native is False and loader.uses_ring is False
     ref = list(_make_loader())
     got = list(loader)
     for (rx, _), (gx, _) in zip(ref, got):

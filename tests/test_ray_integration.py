@@ -36,14 +36,25 @@ WORKER_RUNTIME_ENV = {
 pytestmark = pytest.mark.ray_integration
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="module", autouse=True)
+def _ray_module_teardown():
+    yield
+    if ray.is_initialized():
+        ray.shutdown()
+
+
+@pytest.fixture
 def ray_cluster():
-    """Local 4-slot cluster — parity ``tests/test_ddp.py:20-31``."""
+    """Local 4-slot cluster — parity ``tests/test_ddp.py:20-31``.
+
+    Function-scoped liveness check (cheap no-op when already up) so test
+    ordering cannot hand a later test a cluster the client-server test
+    shut down; the module finalizer above does the single teardown.
+    """
     if not ray.is_initialized():
         ray.init(num_cpus=4, include_dashboard=False,
                  ignore_reinit_error=True)
     yield
-    ray.shutdown()
 
 
 def _strategy(num_workers: int = 2, **kw) -> RayStrategy:
