@@ -8,6 +8,10 @@ the full sequence. Per-chip activation memory scales O(seq_len / sp).
 
     python examples/long_context_example.py --dp 2 --sp 4 --seq-len 2048
 
+``--impl ulysses`` switches to the all-to-all head-sharded variant
+(DeepSpeed-Ulysses style): two GSPMD resharding collectives per attention
+call instead of sp ring hops; needs n_heads divisible by sp.
+
 Off-TPU, use the virtual mesh env (see mnist_ddp_example.py).
 """
 import argparse
@@ -26,6 +30,9 @@ def main():
     parser.add_argument("--use-tpu", action="store_true", default=False)
     parser.add_argument("--size", default="nano",
                         choices=["nano", "small", "medium", "large", "xl"])
+    parser.add_argument("--impl", default="ring",
+                        choices=["ring", "ulysses"],
+                        help="Sequence-parallel attention variant.")
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--batch-size", type=int, default=4)
     parser.add_argument("--max-epochs", type=int, default=2)
@@ -34,7 +41,7 @@ def main():
 
     seq_len = 256 if args.smoke_test else args.seq_len
     cfg = gpt2_config(args.size, max_seq_len=seq_len,
-                      attention_impl="ring")
+                      attention_impl=args.impl)
     model = GPTModule(config=cfg, batch_size=args.batch_size,
                       seq_len=seq_len,
                       num_samples=4 * args.batch_size if args.smoke_test

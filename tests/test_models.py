@@ -412,3 +412,24 @@ def test_bf16_softmax_training_parity(tmp_root):
 
     l32, l16 = run(jnp.float32), run(jnp.bfloat16)
     assert l16 < l32 + 0.15, (l32, l16)
+
+
+def test_scan_unroll_equivalent():
+    """scan_unroll changes XLA scheduling, not math: same weights, same
+    logits as unroll=1."""
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models import TransformerLM
+
+    toks = np.asarray(
+        np.random.default_rng(7).integers(0, 256, size=(2, 16)), np.int32)
+
+    def logits(unroll):
+        cfg = gpt2_config("nano", vocab_size=256, max_seq_len=16,
+                          scan_layers=True, scan_unroll=unroll,
+                          dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        return np.asarray(model.apply({"params": params}, toks))
+
+    np.testing.assert_allclose(logits(1), logits(2), rtol=1e-5, atol=1e-5)
