@@ -436,6 +436,21 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     # against what was actually executed — steps for the steady-state
     # rate, sampled tokens for the end-to-end generation rate
     n_steps = total - 1
+    # Honesty guards (same contract as _measure_rate): a collapsed timing
+    # must raise, never print. Floor: well above clock resolution; bound:
+    # every decode step reads at least all params, so scan-step rate
+    # cannot beat HBM bandwidth over the bf16 param bytes.
+    if best < 0.02:
+        raise MeasurementError(
+            f"decode timing collapsed: {best:.2e}s for {n_steps} scan "
+            "steps — device elided work or async dispatch leaked")
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    hbm_bw = 819e9  # v5e spec; the bound is an order-of-magnitude guard
+    max_step_rate = 1.5 * hbm_bw / (2 * n_params)
+    if n_steps / best > max_step_rate:
+        raise MeasurementError(
+            f"decode rate {n_steps / best:.0f} scan-steps/s exceeds the "
+            f"param-bandwidth bound {max_step_rate:.0f}; timing is wrong")
     return {
         "model": "gpt2_small (bf16 serving params)", "batch": batch,
         "prompt": prompt, "new_tokens": new_tokens,

@@ -71,6 +71,14 @@ class TransformerConfig:
     num_segments: int = 0            # >0 adds segment embeddings (BERT)
 
     def __post_init__(self):
+        if self.scan_unroll < 1:
+            raise ValueError(
+                f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.scan_unroll > 1 and not self.scan_layers:
+            raise ValueError(
+                "scan_unroll is set but scan_layers=False — the unroll "
+                "factor would be silently ignored (the python loop is "
+                "already fully unrolled); drop it or use scan_layers=True")
         if self.remat_policy is not None:
             if not self.remat:
                 raise ValueError(
@@ -296,7 +304,7 @@ class TransformerStack(nn.Module):
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
-                unroll=max(1, min(cfg.scan_unroll, cfg.n_layers)),
+                unroll=min(cfg.scan_unroll, cfg.n_layers),
                 metadata_params={nn.PARTITION_NAME: "layers"})
             (x, _), _ = stack(cfg, deterministic, name="layers")(
                 (x, mask), None)

@@ -119,9 +119,14 @@ def test_actor_teardown_after_fit(ray_cluster, tmp_path):
         from ray.util.state import list_actors
     except ImportError:
         pytest.skip("ray.util.state unavailable on this ray version")
+
+    def field(actor, name):  # dicts on old ray, ActorState objects on new
+        return actor.get(name) if isinstance(actor, dict) \
+            else getattr(actor, name, None)
+
     alive = [a for a in list_actors()
-             if a.get("state") == "ALIVE"
-             and "ExecutorBase" in str(a.get("class_name", ""))]
+             if field(a, "state") == "ALIVE"
+             and "ExecutorBase" in str(field(a, "class_name"))]
     assert not alive, f"executor actors survived teardown: {alive}"
 
 
