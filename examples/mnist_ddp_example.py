@@ -44,8 +44,17 @@ def main():
     parser.add_argument("--data-workers", type=int, default=0,
                         help="Multiprocess data-loader producers (0 = load "
                              "inline on the training process).")
+    parser.add_argument("--use-ray", action="store_true", default=False,
+                        help="Attach to (or start) a Ray cluster and run "
+                             "workers as Ray actors — the reference's "
+                             "deployment shape (ray_ddp_example.py).")
     parser.add_argument("--smoke-test", action="store_true", default=False)
     args = parser.parse_args()
+
+    if args.use_ray:
+        import ray
+        if not ray.is_initialized():
+            ray.init()
 
     num_samples = 1024 if args.smoke_test else 8192
     if args.data_workers > 0:
@@ -56,9 +65,20 @@ def main():
         model = LightningMNISTClassifier(
             config={"lr": args.lr, "batch_size": args.batch_size},
             num_samples=num_samples)
+    # CPU actors over real Ray: each worker forms its own 1-device XLA
+    # world (TPU actors manage visibility via the launcher instead)
+    runtime_env = None
+    if args.use_ray and not args.use_tpu:
+        runtime_env = {"env_vars": {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PALLAS_AXON_POOL_IPS": "",
+        }}
     trainer = Trainer(
         strategy=RayStrategy(num_workers=args.num_workers,
-                             use_tpu=args.use_tpu),
+                             use_tpu=args.use_tpu,
+                             use_ray=args.use_ray or None,
+                             worker_runtime_env=runtime_env),
         max_epochs=1 if args.smoke_test else args.max_epochs,
         callbacks=[EpochStatsCallback()],
         enable_progress_bar=True,
