@@ -69,16 +69,19 @@ def is_session_enabled() -> bool:
             return bool(tune.is_session_enabled())
         except Exception:
             return False
-    # Ray >= 2.x: a live train/tune session context marks the trial process.
+    # Ray >= 2.x: a live train/tune session context marks the trial
+    # process. Public API first (round-2 review: the private-module probe
+    # is the upgrade-fragile one; keep it as the fallback for ray
+    # versions whose get_context() raises outside a session).
     try:
-        from ray.train._internal.session import _get_session
-        if _get_session() is not None:
+        ctx = tune.get_context()
+        if ctx is not None and ctx.get_trial_id() is not None:
             return True
     except Exception:
         pass
     try:
-        ctx = tune.get_context()
-        return ctx is not None and ctx.get_trial_id() is not None
+        from ray.train._internal.session import _get_session
+        return _get_session() is not None
     except Exception:
         return False
 
