@@ -16,6 +16,8 @@ from ray_lightning_tpu.models.pipelined_lm import (PipelinedLMModule,
                                                    PipelinedTransformerLM)
 from ray_lightning_tpu.models.vit import (ViTClassifier, ViTModule,
                                           vit_config)
+from ray_lightning_tpu.models.seq2seq import (Seq2SeqModule,
+                                              Seq2SeqTransformer)
 from ray_lightning_tpu.models.generate import generate, sample_logits
 
 __all__ = [
@@ -26,5 +28,6 @@ __all__ = [
     "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
     "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config",
-    "generate", "sample_logits", "tensor_parallel_rule"
+    "generate", "sample_logits", "tensor_parallel_rule",
+    "Seq2SeqModule", "Seq2SeqTransformer"
 ]

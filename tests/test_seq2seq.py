@@ -1,0 +1,107 @@
+"""Encoder-decoder transformer: cross-attention topology tests.
+
+The reversal task is the behavioral gate: the decoder must emit the
+source backwards, which self-attention over the (shifted) target prefix
+cannot do alone — only cross-attention sees the source. Learning it
+proves the new topology end to end through the Trainer.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import RayStrategy, Trainer
+from ray_lightning_tpu.models import Seq2SeqModule, Seq2SeqTransformer
+from ray_lightning_tpu.models.transformer import TransformerConfig
+
+from utils import get_trainer
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=32, max_seq_len=12, d_model=64, n_heads=4,
+                n_layers=2, d_ff=128, causal=True, dtype=jnp.float32)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_shapes_and_finite():
+    model = Seq2SeqTransformer(_cfg())
+    src = np.asarray([[3, 5, 7, 2], [9, 1, 4, 6]], np.int32)
+    tgt = np.asarray([[2, 7, 5, 3], [6, 4, 1, 9]], np.int32)
+    variables = model.init(jax.random.PRNGKey(0), src, tgt)
+    logits = model.apply(variables, src, tgt)
+    assert logits.shape == (2, 4, 32)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_decoder_is_causal_over_target():
+    """Changing a later target token must not change earlier positions'
+    logits (causal self-attention in the decoder)."""
+    model = Seq2SeqTransformer(_cfg())
+    src = np.asarray([[3, 5, 7, 2]], np.int32)
+    tgt_a = np.asarray([[1, 2, 3, 4]], np.int32)
+    tgt_b = np.asarray([[1, 2, 9, 9]], np.int32)  # differs at pos >= 2
+    variables = model.init(jax.random.PRNGKey(0), src, tgt_a)
+    la = np.asarray(model.apply(variables, src, tgt_a))
+    lb = np.asarray(model.apply(variables, src, tgt_b))
+    np.testing.assert_allclose(la[:, :2], lb[:, :2], rtol=1e-5, atol=1e-6)
+    assert np.abs(la[:, 2:] - lb[:, 2:]).max() > 1e-4
+
+
+def test_cross_attention_sees_source():
+    """Changing the source changes the decoder logits at every position —
+    the cross-attention path is live (not severed by a wiring bug)."""
+    model = Seq2SeqTransformer(_cfg())
+    tgt = np.asarray([[1, 2, 3, 4]], np.int32)
+    src_a = np.asarray([[3, 5, 7, 2]], np.int32)
+    src_b = np.asarray([[8, 8, 8, 8]], np.int32)
+    variables = model.init(jax.random.PRNGKey(0), src_a, tgt)
+    la = np.asarray(model.apply(variables, src_a, tgt))
+    lb = np.asarray(model.apply(variables, src_b, tgt))
+    assert np.abs(la - lb).max() > 1e-4
+
+
+def test_src_mask_hides_padding():
+    """Masked source positions must not influence the output."""
+    model = Seq2SeqTransformer(_cfg())
+    tgt = np.asarray([[1, 2, 3, 4]], np.int32)
+    src_a = np.asarray([[3, 5, 0, 0]], np.int32)
+    src_b = np.asarray([[3, 5, 9, 9]], np.int32)  # differs only in pad
+    mask = np.asarray([[1, 1, 0, 0]], np.int32)
+    variables = model.init(jax.random.PRNGKey(0), src_a, tgt)
+    la = np.asarray(model.apply(variables, src_a, tgt, src_mask=mask))
+    lb = np.asarray(model.apply(variables, src_b, tgt, src_mask=mask))
+    np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
+
+
+def test_reversal_task_learns(tmp_root):
+    """End-to-end through the Trainer on the dp mesh: token accuracy on
+    held-out reversals far above chance (1/vocab ~ 1.6%)."""
+    model = Seq2SeqModule(batch_size=32, seq_len=8, num_samples=512,
+                          vocab_size=64, lr=3e-3)
+    trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
+                          max_epochs=4, limit_train_batches=16,
+                          limit_val_batches=4, checkpoint_callback=False)
+    trainer.fit(model)
+    acc = float(trainer.callback_metrics["val_acc"])
+    assert acc > 0.5, f"cross-attention did not learn reversal: {acc}"
+
+
+def test_encoder_shards_under_tensor_parallel(tmp_root):
+    """Reusing TransformerStack for the encoder buys the Megatron
+    tensor-parallel rule for free: encoder qkv/mlp params shard over tp."""
+    from ray_lightning_tpu import MeshStrategy
+    from ray_lightning_tpu.models.transformer import tensor_parallel_rule
+
+    model = Seq2SeqModule(batch_size=8, seq_len=8, num_samples=16,
+                          vocab_size=32)
+    trainer = get_trainer(
+        tmp_root,
+        strategy=MeshStrategy(axes={"dp": 2, "tp": 2},
+                              param_rule=tensor_parallel_rule),
+        max_epochs=1, limit_train_batches=1, limit_val_batches=0,
+        checkpoint_callback=False)
+    trainer.fit(model)
+    sharded = [l for l in jax.tree_util.tree_leaves(
+        trainer.train_state.params) if not l.sharding.is_fully_replicated]
+    assert sharded, "no seq2seq params sharded under the tp rule"
