@@ -265,11 +265,14 @@ def test_two_process_two_devices_dp_fsdp(tmp_path):
 
 
 @pytest.mark.multiproc
-def test_two_process_sequence_parallel_ring(tmp_path):
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_two_process_sequence_parallel(tmp_path, impl):
     """Sequence parallelism across REAL process boundaries: 2 OS processes
-    form a dp=1 x sp=2 mesh and train a GPT with ring attention — the
-    ppermute K/V rotation crosses the inter-process collective transport,
-    not just intra-process device lanes."""
+    form a dp=1 x sp=2 mesh and train a GPT with each sp attention
+    variant — ring's ppermute K/V rotation and ulysses' all-to-all
+    resharding boundaries both cross the inter-process collective
+    transport, not just intra-process device lanes. (nano has 4 heads,
+    divisible by sp=2, as ulysses requires.)"""
     import jax
 
     from ray_lightning_tpu import SequenceParallelStrategy
@@ -279,7 +282,7 @@ def test_two_process_sequence_parallel_ring(tmp_path):
     ray_mod.init()
     strategy = SequenceParallelStrategy(dp=1, sp=2, num_workers=2)
     cfg = gpt2_config("nano", vocab_size=64, max_seq_len=16,
-                      attention_impl="ring")
+                      attention_impl=impl)
     model = GPTModule(config=cfg, batch_size=4, seq_len=16, num_samples=16)
     trainer = Trainer(strategy=strategy, max_epochs=1, seed=0,
                       limit_train_batches=2, limit_val_batches=0,
