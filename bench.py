@@ -58,17 +58,45 @@ PEAK_BF16_FLOPS = {
 # when the device kind is unknown (e.g. CPU children).
 ABS_MAX_FLOPS = 2e16
 
+# HBM bandwidth (bytes/s) per chip by device kind, same public sources as
+# PEAK_BF16_FLOPS. Used for the decode honesty floor — must track the
+# generation actually running, or a faster chip (v6e ~1.6 TB/s) would
+# legitimately beat a v5e-calibrated floor and be misflagged.
+HBM_BANDWIDTH = {
+    "v2": 700e9,
+    "v3": 900e9,
+    "v4": 1228e9,
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6 lite": 1640e9,
+    "v6e": 1640e9,
+    "trillium": 1640e9,
+}
+# Backstop for unknown kinds: generous enough never to misflag real HW.
+ABS_MAX_HBM_BW = 10e12
+
 
 class MeasurementError(RuntimeError):
     """A throughput measurement that cannot be trusted. Never clamped."""
 
 
-def _chip_peak_flops(device) -> float | None:
+def _lookup_by_kind(table: dict, device, default):
+    """Single device-kind → spec-table matcher, shared by the FLOP and
+    HBM-bandwidth bounds so new generations get added in one shape."""
     kind = getattr(device, "device_kind", "").lower()
-    for key, val in PEAK_BF16_FLOPS.items():
+    for key, val in table.items():
         if key in kind:
             return val
-    return None
+    return default
+
+
+def _chip_peak_flops(device) -> float | None:
+    return _lookup_by_kind(PEAK_BF16_FLOPS, device, None)
+
+
+def _hbm_bandwidth(device) -> float:
+    return _lookup_by_kind(HBM_BANDWIDTH, device, ABS_MAX_HBM_BW)
 
 
 def _step_flops(step, state, batch) -> float | None:
@@ -442,7 +470,7 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     # faster than the bf16 param bytes cross HBM (1.5x slack for spec
     # optimism), nor faster than the clock can resolve.
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    hbm_bw = 819e9  # v5e spec; order-of-magnitude guard
+    hbm_bw = _hbm_bandwidth(jax.devices()[0])
     min_time = max(n_steps * (2 * n_params) / (1.5 * hbm_bw),
                    1000 * time.get_clock_info("perf_counter").resolution)
     if best < min_time:

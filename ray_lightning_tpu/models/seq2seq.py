@@ -31,7 +31,9 @@ from ray_lightning_tpu.data.loader import ArrayDataset, DataLoader
 from ray_lightning_tpu.models.transformer import (MlpBlock,
                                                   MultiHeadAttention,
                                                   TransformerConfig,
-                                                  TransformerStack)
+                                                  TransformerStack,
+                                                  _remat_policy,
+                                                  check_seq_len)
 from ray_lightning_tpu.ops.attention import dot_product_attention
 
 
@@ -112,6 +114,8 @@ class Seq2SeqTransformer(nn.Module):
                 "target tokens and train on the answer")
         B, S = src_tokens.shape
         _, T = tgt_tokens.shape
+        check_seq_len(cfg, S, what="source")
+        check_seq_len(cfg, T, what="target")
         enc_cfg = dataclasses.replace(cfg, causal=False)
 
         additive = None
@@ -139,10 +143,20 @@ class Seq2SeqTransformer(nn.Module):
         x = tgt_embed(tgt_tokens) + nn.Embed(
             cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="tgt_pos")(tpos)
+        # cfg.remat applies to the decoder half too (the encoder gets it
+        # via TransformerStack); scan_layers is encoder-only here — the
+        # decoder's two-stream signature (x, memory) would need its own
+        # scan carry, and seq2seq depth hasn't justified it.
+        block_cls = DecoderBlock
+        if cfg.remat:
+            # deterministic must stay a python bool under remat (dropout
+            # gating branches on it); flax counts argnums from self = 0
+            block_cls = nn.remat(DecoderBlock, prevent_cse=False,
+                                 static_argnums=(4,),
+                                 policy=_remat_policy(cfg))
         for i in range(cfg.n_layers):
-            x = DecoderBlock(cfg, name=f"dec_{i}")(
-                x, memory, memory_mask=additive,
-                deterministic=deterministic)
+            x = block_cls(cfg, name=f"dec_{i}")(
+                x, memory, additive, deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="dec_ln_f")(x)
         logits = tgt_embed.attend(x)
         return logits.astype(jnp.float32)

@@ -272,6 +272,19 @@ class _ScanBlock(nn.Module):
         return (x, mask), None
 
 
+def check_seq_len(cfg: TransformerConfig, length: int,
+                  what: str = "sequence") -> None:
+    """Trace-time guard shared by every model family with learned
+    positions: on TPU, out-of-range ``nn.Embed`` lookups clamp silently,
+    so a too-long sequence would train on garbage positional embeddings
+    instead of raising."""
+    if length > cfg.max_seq_len:
+        raise ValueError(
+            f"{what} length {length} exceeds max_seq_len="
+            f"{cfg.max_seq_len}; positional embeddings would silently "
+            "clamp")
+
+
 def _remat_policy(cfg: TransformerConfig):
     if cfg.remat_policy is None:
         return None
@@ -340,6 +353,8 @@ class TransformerLM(nn.Module):
                  return_hidden: bool = False):
         cfg = self.cfg
         B, T = tokens.shape
+        if positions is None:  # decode mode passes cache-index positions
+            check_seq_len(cfg, T)
         wte = nn.Embed(cfg.vocab_size, cfg.d_model,
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        name="wte")
@@ -372,6 +387,7 @@ class TransformerEncoder(nn.Module):
                  deterministic: bool = True):
         cfg = self.cfg
         B, T = tokens.shape
+        check_seq_len(cfg, T)
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="tok_embed")(tokens)
         pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)

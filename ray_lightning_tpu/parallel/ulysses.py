@@ -38,12 +38,21 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ray_lightning_tpu.ops.attention import dot_product_attention
 from ray_lightning_tpu.parallel.ring_attention import SP_AXIS_NAME, \
     get_sp_mesh
+from ray_lightning_tpu.parallel.sharding import data_axis_names
 
 
 def _spec(mesh, *entries):
     names = mesh.axis_names
-    return NamedSharding(
-        mesh, P(*[e if e is None or e in names else None for e in entries]))
+
+    def keep(e):
+        if e is None or e in names:
+            return e
+        if isinstance(e, tuple):  # multi-axis dim, e.g. ("dp", "fsdp")
+            kept = tuple(a for a in e if a in names)
+            return kept or None
+        return None
+
+    return NamedSharding(mesh, P(*[keep(e) for e in entries]))
 
 
 def ulysses_attention(q: jax.Array,
@@ -76,8 +85,12 @@ def ulysses_attention(q: jax.Array,
             "sp or attention_impl='ring' (which shards sequence, not "
             "heads)")
 
-    seq_spec = _spec(mesh, "dp", SP_AXIS_NAME, None, None)
-    head_spec = _spec(mesh, "dp", None, SP_AXIS_NAME, None)
+    # Resolve the batch axes the way sp_sharded_attention does, so custom
+    # meshes that name their data axis "fsdp" (or shard batch over both)
+    # keep the batch dim pinned at both resharding boundaries.
+    batch = data_axis_names(mesh) or None
+    seq_spec = _spec(mesh, batch, SP_AXIS_NAME, None, None)
+    head_spec = _spec(mesh, batch, None, SP_AXIS_NAME, None)
 
     # boundary 1: sequence-sharded -> head-sharded (XLA emits all-to-all)
     q, k, v = (jax.lax.with_sharding_constraint(x, head_spec)

@@ -74,6 +74,41 @@ def test_src_mask_hides_padding():
     np.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
 
 
+def test_oversized_sequence_raises_at_trace_time():
+    """Positions beyond max_seq_len must raise, not silently clamp (TPU
+    Embed lookups clamp out-of-range indices)."""
+    model = Seq2SeqTransformer(_cfg(max_seq_len=4))
+    src = np.zeros((1, 6), np.int32)  # 6 > max_seq_len=4
+    tgt = np.zeros((1, 3), np.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.init(jax.random.PRNGKey(0), src, tgt)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.init(jax.random.PRNGKey(0), tgt, src)  # oversized target
+
+
+def test_decoder_remat_matches_plain():
+    """cfg.remat wraps the decoder blocks too; outputs must be identical
+    (remat changes the backward schedule, never the math)."""
+    src = np.asarray([[3, 5, 7, 2]], np.int32)
+    tgt = np.asarray([[1, 2, 3, 4]], np.int32)
+    plain = Seq2SeqTransformer(_cfg())
+    variables = plain.init(jax.random.PRNGKey(0), src, tgt)
+    remat = Seq2SeqTransformer(
+        _cfg(remat=True, remat_policy="dots_with_no_batch_dims"))
+
+    def loss(m, v):
+        return jnp.sum(m.apply(v, src, tgt).astype(jnp.float32) ** 2)
+
+    la, ga = loss(plain, variables), jax.grad(
+        lambda v: loss(plain, v))(variables)
+    lb, gb = loss(remat, variables), jax.grad(
+        lambda v: loss(remat, v))(variables)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), ga, gb)
+
+
 def test_reversal_task_learns(tmp_root):
     """End-to-end through the Trainer on the dp mesh: token accuracy on
     held-out reversals far above chance (1/vocab ~ 1.6%)."""
