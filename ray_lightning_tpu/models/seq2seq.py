@@ -32,8 +32,8 @@ from ray_lightning_tpu.models.transformer import (MlpBlock,
                                                   MultiHeadAttention,
                                                   TransformerConfig,
                                                   TransformerStack,
-                                                  _remat_policy,
-                                                  check_seq_len)
+                                                  check_seq_len,
+                                                  maybe_remat)
 from ray_lightning_tpu.ops.attention import dot_product_attention
 
 
@@ -147,13 +147,7 @@ class Seq2SeqTransformer(nn.Module):
         # via TransformerStack); scan_layers is encoder-only here — the
         # decoder's two-stream signature (x, memory) would need its own
         # scan carry, and seq2seq depth hasn't justified it.
-        block_cls = DecoderBlock
-        if cfg.remat:
-            # deterministic must stay a python bool under remat (dropout
-            # gating branches on it); flax counts argnums from self = 0
-            block_cls = nn.remat(DecoderBlock, prevent_cse=False,
-                                 static_argnums=(4,),
-                                 policy=_remat_policy(cfg))
+        block_cls = maybe_remat(DecoderBlock, cfg, deterministic_argnum=4)
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"dec_{i}")(
                 x, memory, additive, deterministic)

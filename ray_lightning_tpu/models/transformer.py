@@ -285,6 +285,22 @@ def check_seq_len(cfg: TransformerConfig, length: int,
             "clamp")
 
 
+def maybe_remat(block_cls, cfg: TransformerConfig, *,
+                deterministic_argnum: int):
+    """Wrap a block class in ``nn.remat`` when ``cfg.remat`` is set —
+    the one source of truth for remat options across block families.
+
+    ``deterministic_argnum`` indexes the block's ``deterministic`` arg
+    counting ``self`` as 0 (flax subtracts 1 internally); it must stay a
+    python bool under remat because dropout gating branches on it.
+    """
+    if not cfg.remat:
+        return block_cls
+    return nn.remat(block_cls, prevent_cse=False,
+                    static_argnums=(deterministic_argnum,),
+                    policy=_remat_policy(cfg))
+
+
 def _remat_policy(cfg: TransformerConfig):
     if cfg.remat_policy is None:
         return None
@@ -322,13 +338,8 @@ class TransformerStack(nn.Module):
             (x, _), _ = stack(cfg, deterministic, name="layers")(
                 (x, mask), None)
             return x
-        block_cls = TransformerBlock
-        if cfg.remat:
-            # deterministic must stay a python bool under remat (dropout
-            # gating branches on it); flax counts argnums from self = 0
-            block_cls = nn.remat(TransformerBlock, prevent_cse=False,
-                                 static_argnums=(3,),
-                                 policy=_remat_policy(cfg))
+        block_cls = maybe_remat(TransformerBlock, cfg,
+                                deterministic_argnum=3)
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block_{i}")(x, mask, deterministic)
         return x
