@@ -8,11 +8,33 @@ Ray Client server. Mirrors the reference's core fixtures
 (``ray_lightning/tests/test_ddp.py:20-31,214-238``,
 ``tests/test_tune.py:41-92``, ``tests/test_client.py:10-22``).
 
-Skip-gated on ray importability: runs in the ``test-with-ray`` CI job
-(``pip install ray[tune]``); environments without ray skip cleanly.
-Workers are real Ray actor processes that must form their own
-1-CPU-device-per-process XLA worlds, overriding the suite's 8-virtual-
-device driver env via each actor's ``runtime_env``.
+Skip-gated on ray importability: runs in the ``test-with-ray`` CI job,
+which pins ``ray[tune]==2.9.3`` so the tier is deterministic (the
+reference pins its ray axis the same way, ``.github/workflows/
+test.yaml:43-47``); a separate continue-on-error job tracks latest.
+Environments without ray skip cleanly. Workers are real Ray actor
+processes that must form their own 1-CPU-device-per-process XLA worlds,
+overriding the suite's 8-virtual-device driver env via each actor's
+``runtime_env``.
+
+API audit against the pinned ray 2.9 (every real-ray symbol this file
+touches, and since when it exists):
+
+- ``ray.init(num_cpus=, include_dashboard=, ignore_reinit_error=)`` — 1.x
+- ``ray.util.state.list_actors`` — state API, 2.1+ (ImportError-guarded;
+  returns ``ActorState`` objects on 2.7+, dicts before — both handled)
+- ``ray.util.queue.Queue(actor_options=)`` / ``.shutdown()`` — 1.x
+- ``tune.run(metric=, mode=, resources_per_trial=, config=, verbose=)``
+  — 1.x surface, still present in 2.9 alongside ``Tuner``
+- ``tune.run(storage_path=)`` — 2.7+ (version-gated to ``local_dir``
+  below for older installs)
+- ``analysis.best_checkpoint`` → ``ray.train.Checkpoint`` with
+  ``.as_directory()`` — context-manager form since 2.0 (``ray.air``),
+  module move in 2.7; attribute access is identical either way
+- ``ray.util.client.ray_client_helpers.ray_start_client_server`` — test
+  helper, present 1.x→2.9 (ImportError-guarded skip)
+- ``@ray.remote(num_cpus=)`` tasks, ``ray.get``, ``ray.is_initialized``,
+  ``ray.shutdown`` — core 1.x
 """
 import os
 
@@ -24,6 +46,30 @@ ray = pytest.importorskip("ray")
 from ray_lightning_tpu import RayStrategy, Trainer  # noqa: E402
 from ray_lightning_tpu.launchers.ray_launcher import RayLauncher  # noqa: E402
 from ray_lightning_tpu.models import BoringModel  # noqa: E402
+
+
+def _ray_version() -> tuple:
+    """(major, minor) of the installed ray; (0, 0) for unparseable dev
+    builds, which then take the oldest-API branch (safe: old kwargs are
+    kept as aliases far longer than new ones exist backward)."""
+    parts = []
+    for tok in ray.__version__.split(".")[:2]:
+        digits = "".join(c for c in tok if c.isdigit())
+        if not digits:
+            return (0, 0)
+        parts.append(int(digits))
+    return tuple(parts) if len(parts) == 2 else (0, 0)
+
+
+def _tune_storage_kwargs(path: str) -> dict:
+    """``tune.run``'s results-dir kwarg was renamed ``local_dir`` →
+    ``storage_path`` in ray 2.7; the CI job pins ray (2.9.3) but this
+    tier is skip-gated to run wherever ray imports, so the first real
+    execution must not die on a kwarg mismatch."""
+    if _ray_version() >= (2, 7):
+        return {"storage_path": path}
+    return {"local_dir": path}
+
 
 WORKER_RUNTIME_ENV = {
     "env_vars": {
@@ -226,8 +272,8 @@ def test_live_tune_run_round_trip(ray_cluster, tmp_path):
         config={"seed": tune.grid_search([0, 1]),
                 "max_epochs": max_epochs},
         resources_per_trial=get_tune_resources(num_workers=1),
-        metric="loss", mode="min",
-        storage_path=str(tmp_path / "tune"), verbose=0)
+        metric="loss", mode="min", verbose=0,
+        **_tune_storage_kwargs(str(tmp_path / "tune")))
 
     assert len(analysis.trials) == 2
     for trial in analysis.trials:
