@@ -36,6 +36,19 @@ def _make_backend():
     return ProcessRay(worker_env=dict(WORKER_ENV))
 
 
+def _assert_params_match(remote_params, local_params):
+    """Single source of truth for remote-vs-local equivalence: leaf-wise
+    identical param trees (atol covers f32 reduction-order wiggle)."""
+    import jax
+
+    remote_leaves = jax.tree_util.tree_leaves(remote_params)
+    local_leaves = [np.asarray(x)
+                    for x in jax.tree_util.tree_leaves(local_params)]
+    assert len(remote_leaves) == len(local_leaves)
+    for r, l in zip(remote_leaves, local_leaves):
+        np.testing.assert_allclose(np.asarray(r), l, atol=1e-5)
+
+
 def _fit_with_process_backend(num_workers: int, tmp_path, seed: int = 0):
     ray_mod = _make_backend()
     ray_mod.init()
@@ -79,16 +92,8 @@ def test_two_process_fit_matches_single_process(tmp_path):
                     default_root_dir=str(tmp_path / "local"))
     local.fit(BoringModel(batch_size=8))
 
-    remote_params = remote.train_state_dict["params"]
-    local_params = local.train_state.params
-
-    import jax
-    remote_leaves = jax.tree_util.tree_leaves(remote_params)
-    local_leaves = [np.asarray(x)
-                    for x in jax.tree_util.tree_leaves(local_params)]
-    assert len(remote_leaves) == len(local_leaves)
-    for r, l in zip(remote_leaves, local_leaves):
-        np.testing.assert_allclose(np.asarray(r), l, atol=1e-5)
+    _assert_params_match(remote.train_state_dict["params"],
+                         local.train_state.params)
 
 
 class ExplodingModel(BoringModel):
@@ -225,8 +230,6 @@ def test_two_process_two_devices_dp_fsdp(tmp_path):
     2 devices own), ``assert_mesh_process_alignment`` over a >1-device-per-
     process order, and cross-process collectives with intra-process lanes.
     Equivalence: params must match the single-process 4-device run."""
-    import jax
-
     from ray_lightning_tpu import MeshStrategy
 
     env = dict(WORKER_ENV)
@@ -255,13 +258,8 @@ def test_two_process_two_devices_dp_fsdp(tmp_path):
                     default_root_dir=str(tmp_path / "local"))
     local.fit(BoringModel(batch_size=8))
 
-    remote_leaves = jax.tree_util.tree_leaves(
-        trainer.train_state_dict["params"])
-    local_leaves = [np.asarray(x) for x in
-                    jax.tree_util.tree_leaves(local.train_state.params)]
-    assert len(remote_leaves) == len(local_leaves)
-    for r, l in zip(remote_leaves, local_leaves):
-        np.testing.assert_allclose(np.asarray(r), l, atol=1e-5)
+    _assert_params_match(trainer.train_state_dict["params"],
+                         local.train_state.params)
 
 
 @pytest.mark.multiproc
@@ -304,8 +302,6 @@ def test_two_process_tensor_parallel(tmp_path):
     """Megatron tensor parallelism across process boundaries: dp=1 x tp=2
     over 2 OS processes — the per-block all-reduce rides the inter-process
     collective transport."""
-    import jax
-
     from ray_lightning_tpu import MeshStrategy
     from ray_lightning_tpu.models import GPTModule, gpt2_config
     from ray_lightning_tpu.models.transformer import tensor_parallel_rule
@@ -326,6 +322,82 @@ def test_two_process_tensor_parallel(tmp_path):
     finally:
         ray_mod.shutdown()
     assert trainer.global_step == 2
+
+
+def _fit_remote_and_local_equiv(tmp_path, strategy_remote, strategy_local,
+                                make_model, epochs: int = 1,
+                                batches: int = 2):
+    """Shared harness for the per-parallelism-family equivalence tests:
+    fit across 2 OS processes, fit the same mesh single-process on the
+    parent's virtual devices, and require identical params."""
+    ray_mod = _make_backend()
+    ray_mod.init()
+    trainer = Trainer(strategy=strategy_remote, max_epochs=epochs, seed=0,
+                      limit_train_batches=batches, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False,
+                      default_root_dir=str(tmp_path / "remote"))
+    trainer._launcher = RayLauncher(strategy_remote, ray_module=ray_mod)
+    try:
+        trainer.fit(make_model())
+    finally:
+        ray_mod.shutdown()
+    assert trainer.global_step == epochs * batches
+
+    local = Trainer(strategy=strategy_local, max_epochs=epochs, seed=0,
+                    limit_train_batches=batches, limit_val_batches=0,
+                    num_sanity_val_steps=0, enable_checkpointing=False,
+                    default_root_dir=str(tmp_path / "local"))
+    local.fit(make_model())
+
+    _assert_params_match(trainer.train_state_dict["params"],
+                         local.train_state.params)
+
+
+@pytest.mark.multiproc
+def test_two_process_expert_parallel_matches_single_process(tmp_path):
+    """MoE expert parallelism across REAL process boundaries (the last
+    VERDICT r03 asymmetry, with pp below: dp/tp/sp had cross-process
+    proofs; ep/pp only dryrun). 2 OS processes form a dp=1 x ep=2 mesh —
+    the token dispatch/combine collectives cross the inter-process
+    transport — and params must match the same mesh run single-process."""
+    from ray_lightning_tpu import MeshStrategy
+    from ray_lightning_tpu.models.moe import MoeModule, expert_parallel_rule
+
+    def make_model():
+        return MoeModule(size="nano", batch_size=4, seq_len=16,
+                         num_samples=16, vocab_size=64)
+
+    _fit_remote_and_local_equiv(
+        tmp_path,
+        MeshStrategy(axes={"dp": 1, "ep": 2},
+                     param_rule=expert_parallel_rule, num_workers=2),
+        MeshStrategy(axes={"dp": 1, "ep": 2},
+                     param_rule=expert_parallel_rule, use_ray=False),
+        make_model)
+
+
+@pytest.mark.multiproc
+def test_two_process_pipeline_parallel_matches_single_process(tmp_path):
+    """GPipe pipeline parallelism across REAL process boundaries: pp=2
+    with one stage per OS process, the microbatch activation handoff
+    riding the inter-process transport; params must match the same mesh
+    run single-process."""
+    from ray_lightning_tpu import MeshStrategy
+    from ray_lightning_tpu.models.pipelined_lm import PipelinedLMModule
+    from ray_lightning_tpu.parallel.pipeline import pipeline_parallel_rule
+
+    def make_model():
+        return PipelinedLMModule(n_layers=2, batch_size=4, seq_len=16,
+                                 num_samples=16, vocab_size=64,
+                                 n_microbatches=2)
+
+    _fit_remote_and_local_equiv(
+        tmp_path,
+        MeshStrategy(axes={"pp": 2, "dp": 1},
+                     param_rule=pipeline_parallel_rule, num_workers=2),
+        MeshStrategy(axes={"pp": 2, "dp": 1},
+                     param_rule=pipeline_parallel_rule, use_ray=False),
+        make_model)
 
 
 def _host_local_feed_worker(global_seed: int, batch: int, dim: int):
