@@ -193,7 +193,9 @@ def _build_bert_step(strategy, batch_size: int, seq_len: int):
 
 
 def _build_gpt2_step(strategy, batch_size: int, seq_len: int,
-                     size: str = "small"):
+                     size: str = "small", optimizer: str = "adamw",
+                     scan_unroll: int = 1, chunk_size: int = 2048,
+                     remat_policy: str = "dots_with_no_batch_dims"):
     """Flagship model (GPT-2-small, the ``entry()`` model) train step.
 
     Config from the round-3 v5e sweep + HLO trace: bs 8 / seq 512 / bf16 /
@@ -210,7 +212,6 @@ def _build_gpt2_step(strategy, batch_size: int, seq_len: int,
     assumed.
     """
     import jax.numpy as jnp
-    import optax
 
     from ray_lightning_tpu.models.gpt import gpt2_config
     from ray_lightning_tpu.models.transformer import TransformerLM
@@ -228,23 +229,27 @@ def _build_gpt2_step(strategy, batch_size: int, seq_len: int,
     # training-quality parity pinned by test_models.py
     # (test_bf16_softmax_training_parity).
     cfg = gpt2_config(size, vocab_size=50304, max_seq_len=seq_len,
-                      dtype=jnp.bfloat16, scan_layers=scan, remat=True,
-                      remat_policy="dots_with_no_batch_dims",
+                      dtype=jnp.bfloat16, scan_layers=scan,
+                      scan_unroll=scan_unroll if scan else 1,
+                      remat=remat_policy != "none",
+                      remat_policy=None if remat_policy in ("none", "full")
+                      else remat_policy,
                       attention_softmax_dtype=jnp.bfloat16)
     model = TransformerLM(cfg)
-    tx = optax.adamw(3e-4, weight_decay=0.1)
+    from ray_lightning_tpu.core.optim import make_optimizer
+    tx = make_optimizer(optimizer, 3e-4, weight_decay=0.1)
     toks = np.random.default_rng(0).integers(
         0, 50257, size=(batch_size, seq_len + 1)).astype(np.int32)
 
     def loss_fn(params, model_state, batch, rng):
         x, y = batch[:, :-1], batch[:, 1:]
         hidden = model.apply({"params": params}, x, return_hidden=True)
-        if scan:
+        if scan and chunk_size > 0:
             from ray_lightning_tpu.ops.lm_head_loss import (
                 chunked_lm_head_xent)
             loss = chunked_lm_head_xent(hidden,
                                         params["wte"]["embedding"], y,
-                                        chunk_size=2048)
+                                        chunk_size=chunk_size)
         else:
             loss = lm_head_xent(hidden, params["wte"]["embedding"], y)
         return loss, ({}, model_state)
@@ -738,13 +743,14 @@ def main() -> None:
     except Exception as exc:  # secondary benches degrade to a diagnostic
         extras["bert_base"] = {"error": f"{type(exc).__name__}: {exc}"}
 
-    def gpt_extra(key: str, size: str, best_of: int) -> None:
-        gpt_bs, gpt_seq = 8, 512
+    def gpt_extra(key: str, size: str, best_of: int,
+                  gpt_bs: int = 8, **build_kw) -> None:
+        gpt_seq = 512
         try:
             gpt = bench_model(_build_gpt2_step, samples_per_step=gpt_bs,
                               analytic_tokens=gpt_bs * gpt_seq,
                               batch_size=gpt_bs, seq_len=gpt_seq,
-                              size=size, best_of=best_of)
+                              size=size, best_of=best_of, **build_kw)
             extras[key] = {
                 "samples_per_sec_per_chip": round(
                     gpt["samples_per_sec_per_chip"], 2),
@@ -753,6 +759,7 @@ def main() -> None:
                 "mfu": round(gpt["mfu"], 4) if gpt["mfu"] else None,
                 "batch": gpt_bs, "seq_len": gpt_seq,
             }
+            extras[key].update(build_kw)  # provenance: every layout knob
         except Exception as exc:
             extras[key] = {"error": f"{type(exc).__name__}: {exc}"}
 
@@ -786,8 +793,13 @@ def main() -> None:
     # medium (355M) brushes the 16 GB HBM ceiling by design — an OOM here
     # poisons subsequent allocations in this backend (observed: flash +
     # batch_scaling inherited RESOURCE_EXHAUSTED), so it runs AFTER every
-    # other on-chip section
-    gpt_extra("gpt2_medium", "medium", 2)
+    # other on-chip section. Round-4 config: factored optimizer states
+    # (adafactor) free ~2.1 GB vs plain adamw, which buys bs 12 (adamw
+    # OOMs at 12) + the save_attn remat policy — interleaved A/B:
+    # 86.8 -> 95.1 sps (MFU 0.480 -> 0.525), see docs/performance.md
+    gpt_extra("gpt2_medium", "medium", 2, gpt_bs=12,
+              optimizer="adafactor",
+              remat_policy="dots_with_no_batch_dims_save_attn")
 
     try:
         extras["scaling"] = bench_scaling()

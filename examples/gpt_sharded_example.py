@@ -31,12 +31,17 @@ def main():
     parser.add_argument("--generate", type=int, default=0, metavar="N",
                         help="after training, decode N tokens from the "
                              "trained weights with the KV-cache sampler")
+    parser.add_argument("--optimizer", default="adamw",
+                        choices=["adamw", "adamw_bf16m", "adafactor"],
+                        help="memory-efficient presets free optimizer-"
+                             "state HBM for bigger batches/models on a "
+                             "chip (core/optim.py)")
     parser.add_argument("--smoke-test", action="store_true", default=False)
     args = parser.parse_args()
 
     strategy_cls = FSDPStrategy if args.fsdp else RayShardedStrategy
     model = GPTModule(size=args.size, batch_size=args.batch_size,
-                      seq_len=args.seq_len,
+                      seq_len=args.seq_len, optimizer=args.optimizer,
                       num_samples=4 * args.batch_size if args.smoke_test
                       else 64 * args.batch_size)
     trainer = Trainer(

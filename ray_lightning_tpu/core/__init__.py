@@ -7,6 +7,7 @@ from ray_lightning_tpu.core.callbacks import (Callback, EarlyStopping,
                                               ModelCheckpoint,
                                               EpochStatsCallback)
 from ray_lightning_tpu.core.loggers import CSVLogger, JaxProfilerCallback
+from ray_lightning_tpu.core.optim import make_optimizer, opt_state_bytes
 from ray_lightning_tpu.core.profiler import (PassThroughProfiler,
                                              SimpleProfiler)
 from ray_lightning_tpu.core.seed import seed_everything, reset_seed
@@ -16,5 +17,6 @@ __all__ = [
     "EMAWeightAveraging", "LambdaCallback",
     "LearningRateMonitor", "ModelCheckpoint", "EpochStatsCallback",
     "CSVLogger", "JaxProfilerCallback", "PassThroughProfiler",
-    "SimpleProfiler", "seed_everything", "reset_seed"
+    "SimpleProfiler", "seed_everything", "reset_seed",
+    "make_optimizer", "opt_state_bytes"
 ]

@@ -52,7 +52,8 @@ class GPTModule(TpuModule):
                  num_samples: int = 256,
                  lr: float = 3e-4,
                  weight_decay: float = 0.1,
-                 vocab_size: int = 1024):
+                 vocab_size: int = 1024,
+                 optimizer: str = "adamw"):
         super().__init__()
         if config is None:
             seq_len = 128 if seq_len is None else seq_len
@@ -69,13 +70,18 @@ class GPTModule(TpuModule):
         self.num_samples = num_samples
         self.lr = lr
         self.weight_decay = weight_decay
+        self.optimizer = optimizer
 
     def configure_model(self):
         return TransformerLM(self.cfg)
 
     def configure_optimizers(self):
-        return optax.adamw(self.lr, weight_decay=self.weight_decay,
-                           b2=0.95)
+        # memory-efficient presets ("adamw_bf16m", "adafactor") buy back
+        # the optimizer-state HBM that forces large models into slow
+        # layouts on one chip — see core/optim.py
+        from ray_lightning_tpu.core.optim import make_optimizer
+        return make_optimizer(self.optimizer, self.lr,
+                              weight_decay=self.weight_decay, b2=0.95)
 
     def _loader(self, seed: int, shuffle: bool = False):
         toks = synthetic_tokens(self.num_samples, self.seq_len + 1,

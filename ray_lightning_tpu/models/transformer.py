@@ -85,11 +85,12 @@ class TransformerConfig:
                     "remat_policy is set but remat=False — the policy "
                     "would be silently ignored; pass remat=True (or drop "
                     "the policy)")
-            if self.remat_policy not in ("dots",
-                                         "dots_with_no_batch_dims"):
+            valid = ("dots", "dots_with_no_batch_dims",
+                     "dots_with_no_batch_dims_save_attn",
+                     "dots_with_no_batch_dims_save_attn_mlp")
+            if self.remat_policy not in valid:
                 raise ValueError(
-                    f"remat_policy must be 'dots', "
-                    f"'dots_with_no_batch_dims' or None, got "
+                    f"remat_policy must be one of {valid} or None, got "
                     f"{self.remat_policy!r}")
 
     @property
@@ -189,6 +190,13 @@ class MultiHeadAttention(nn.Module):
         out = attn(q, k, v, causal=causal, mask=mask,
                    dropout_rate=cfg.dropout if not deterministic else 0.0,
                    dropout_rng=drop_rng, **kw)
+        # named checkpoint seat for the "...save_attn" remat policies:
+        # saving this one (B,T,H,D) tensor lets backward skip recomputing
+        # the whole attention chain (scores, softmax, AV) at the cost of
+        # seq*d_model bf16 bytes per layer — the right trade once HBM
+        # headroom exists (memory-efficient optimizer states)
+        from jax.ad_checkpoint import checkpoint_name
+        out = checkpoint_name(out, "attn_out")
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
         return nn.DenseGeneral(
             features=cfg.d_model, dtype=cfg.dtype,
@@ -234,6 +242,9 @@ class MlpBlock(nn.Module):
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="up")(x)
         h = nn.gelu(h)
+        # named seat for remat policies that save the GELU output
+        from jax.ad_checkpoint import checkpoint_name
+        h = checkpoint_name(h, "mlp_act")
         h = nn.Dense(cfg.d_model, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype, name="down")(h)
         if cfg.dropout > 0.0 and not deterministic:
@@ -304,10 +315,23 @@ def maybe_remat(block_cls, cfg: TransformerConfig, *,
 def _remat_policy(cfg: TransformerConfig):
     if cfg.remat_policy is None:
         return None
+    cp = jax.checkpoint_policies
     policies = {
-        "dots": jax.checkpoint_policies.checkpoint_dots,
-        "dots_with_no_batch_dims":
-            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        "dots": cp.checkpoint_dots,
+        "dots_with_no_batch_dims": cp.checkpoint_dots_with_no_batch_dims,
+        # additionally save each block's attention output (named
+        # checkpoint in MultiHeadAttention): backward skips the full
+        # attention recompute for seq*d_model bf16 bytes per layer —
+        # the right trade once HBM headroom exists (see
+        # docs/performance.md for the measured effect)
+        "dots_with_no_batch_dims_save_attn": cp.save_from_both_policies(
+            cp.checkpoint_dots_with_no_batch_dims,
+            cp.save_only_these_names("attn_out")),
+        # ...and the (B,T,d_ff) GELU output too — 4x the bytes of
+        # attn_out; only for real HBM headroom
+        "dots_with_no_batch_dims_save_attn_mlp": cp.save_from_both_policies(
+            cp.checkpoint_dots_with_no_batch_dims,
+            cp.save_only_these_names("attn_out", "mlp_act")),
     }
     if cfg.remat_policy not in policies:
         raise ValueError(f"remat_policy must be one of "

@@ -102,7 +102,10 @@ def test_gpt_remat_matches(tmp_root):
     # so each layout compares against its own no-remat base. "dots" sits
     # between the two policies tested (its callable is jax's own); a trace
     # per case is ~6s on CPU, so the matrix stays minimal.
-    cases = [(True, (None, "dots_with_no_batch_dims")),
+    # save_attn = the round-4 gpt2_medium bench policy (named-checkpoint
+    # seat in MultiHeadAttention) — same math contract as the others
+    cases = [(True, (None, "dots_with_no_batch_dims",
+                     "dots_with_no_batch_dims_save_attn")),
              (False, ("dots_with_no_batch_dims",))]  # the bench config
     for scan, policies in cases:
         g_base = grads(False, scan=scan)
