@@ -60,6 +60,13 @@ def make_optimizer(name: str = "adamw",
             f"unknown optimizer {name!r}; expected one of "
             f"{OPTIMIZER_NAMES}")
     if name == "adafactor" or factored:
+        if weight_decay and callable(learning_rate):
+            raise ValueError(
+                "the adafactor preset scales weight_decay by the (scalar)"
+                " learning rate for adamw parity (optax.adafactor applies"
+                " decay after lr scaling); with an LR schedule that"
+                " constant does not exist — pass weight_decay=0 and"
+                " compose decay explicitly, or use a scalar learning rate")
         # NB: adafactor's decay_rate is the exponent of its step-dependent
         # second-moment schedule (1 - step^-0.8), NOT an adam beta — b2
         # deliberately does not map onto it

@@ -7,8 +7,8 @@ from ray_lightning_tpu.models.transformer import (tensor_parallel_rule,
                                                   TransformerEncoder)
 from ray_lightning_tpu.models.gpt import GPTModule, gpt2_config, count_params
 from ray_lightning_tpu.models.bert import BertModule, BertClassifier, bert_config
-from ray_lightning_tpu.models.resnet import (ResNetModule, resnet18,
-                                             resnet50)
+from ray_lightning_tpu.models.resnet import (ResNetModule, resnet10,
+                                             resnet18, resnet50)
 from ray_lightning_tpu.models.moe import (MoeConfig, MoeModule,
                                           MoeTransformerLM,
                                           expert_parallel_rule, moe_config)
@@ -25,7 +25,7 @@ __all__ = [
     "MNISTClassifier", "TransformerConfig", "TransformerLM",
     "TransformerEncoder", "GPTModule", "gpt2_config", "count_params",
     "BertModule", "BertClassifier", "bert_config", "ResNetModule",
-    "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
+    "resnet10", "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
     "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config",
     "generate", "sample_logits", "tensor_parallel_rule",

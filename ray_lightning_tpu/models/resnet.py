@@ -96,6 +96,12 @@ class ResNet(nn.Module):
         return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
 
 
+def resnet10(num_classes=10, **kw):
+    """One block per stage — the CI/debug tier: same stem, BatchNorm
+    and residual topology as resnet18 at half the trace/compile cost."""
+    return ResNet([1, 1, 1, 1], ResNetBlock, num_classes=num_classes, **kw)
+
+
 def resnet18(num_classes=10, **kw):
     return ResNet([2, 2, 2, 2], ResNetBlock, num_classes=num_classes, **kw)
 
@@ -130,7 +136,7 @@ class ResNetModule(TpuModule):
         self.dtype = dtype
 
     def configure_model(self):
-        factory = {18: resnet18, 50: resnet50}[self.depth]
+        factory = {10: resnet10, 18: resnet18, 50: resnet50}[self.depth]
         return factory(self.num_classes, dtype=self.dtype)
 
     def configure_optimizers(self):

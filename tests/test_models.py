@@ -102,11 +102,14 @@ def test_gpt_remat_matches(tmp_root):
     # so each layout compares against its own no-remat base. "dots" sits
     # between the two policies tested (its callable is jax's own); a trace
     # per case is ~6s on CPU, so the matrix stays minimal.
-    # save_attn = the round-4 gpt2_medium bench policy (named-checkpoint
-    # seat in MultiHeadAttention) — same math contract as the others
-    cases = [(True, (None, "dots_with_no_batch_dims",
-                     "dots_with_no_batch_dims_save_attn")),
-             (False, ("dots_with_no_batch_dims",))]  # the bench config
+    # save_attn = the round-4 gpt2 bench policy (named-checkpoint seat in
+    # MultiHeadAttention) — same math contract as the others. The matrix
+    # covers BOTH shipped bench combos (medium: scanned + save_attn;
+    # small: unrolled + save_attn) plus full remat and dots_nb once each
+    # (a trace costs ~6 s on CPU, so no redundant cells).
+    cases = [(True, (None, "dots_with_no_batch_dims_save_attn")),
+             (False, ("dots_with_no_batch_dims",
+                      "dots_with_no_batch_dims_save_attn"))]
     for scan, policies in cases:
         g_base = grads(False, scan=scan)
         for policy in policies:
@@ -173,7 +176,10 @@ def test_resnet18_batchstats_update(tmp_root):
 
 
 def test_resnet_learns(tmp_root):
-    model = ResNetModule(depth=18, batch_size=16, num_samples=128, lr=0.05)
+    """Behavioral gate on the conv/BatchNorm family. depth=10 (the CI
+    tier): same stem/residual/BN topology as 18 at half the trace cost —
+    this test was the suite's #1 runtime rock at depth=18 (~49 s)."""
+    model = ResNetModule(depth=10, batch_size=16, num_samples=128, lr=0.05)
     trainer = get_trainer(tmp_root, strategy=RayStrategy(num_workers=2),
                           max_epochs=2, limit_train_batches=8,
                           limit_val_batches=4, checkpoint_callback=False)
