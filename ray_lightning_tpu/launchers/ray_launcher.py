@@ -129,7 +129,18 @@ class RayLauncher:
     (parity: ``ray_ddp.py:128-136``).
     """
 
-    def __init__(self, strategy, ray_module: Any = None):
+    def __init__(self, strategy, ray_module: Any = None,
+                 workers: Optional[List[Any]] = None):
+        """``workers``: externally-owned executor actors to reuse instead
+        of creating (and killing) a fresh set per ``launch()``. The
+        caller owns their lifetime. Consecutive fits skip actor spawn +
+        interpreter/jax cold start per worker; the first fit's
+        ``jax.distributed`` world persists (worker_setup's
+        already-initialized guard), so every reuse must keep the same
+        process count and rank order. The reference's analog is Tune's
+        ``reuse_actors``; here it is a launcher-level seam (also what
+        keeps the multiproc test tier affordable).
+        """
         self._strategy = strategy
         self._ray = ray_module if ray_module is not None else _import_ray()
         if self._ray is None:
@@ -140,6 +151,12 @@ class RayLauncher:
         if not self._ray.is_initialized():
             # Parity: ``ray_launcher.py:41-42`` — connect on first use.
             self._ray.init()
+        self._external_workers = workers
+        if workers is not None and len(workers) != strategy.num_workers:
+            raise ValueError(
+                f"{len(workers)} external workers for a strategy needing "
+                f"num_workers={strategy.num_workers}; persistent worlds "
+                "must keep the same process count")
         self._workers: List[Any] = []
         self._tpu_request: Optional[int] = None
         self._coordinator_address: Optional[str] = None
@@ -173,11 +190,15 @@ class RayLauncher:
         Parity: ``ray_launcher.py:71-103``.
         """
         strategy = self._strategy
-        if strategy.use_tpu and not strategy.allow_colocated_workers:
-            self._check_enough_tpu_hosts()
-        self._workers = [
-            self._create_worker(rank) for rank in range(strategy.num_workers)
-        ]
+        if self._external_workers is not None:
+            self._workers = list(self._external_workers)
+        else:
+            if strategy.use_tpu and not strategy.allow_colocated_workers:
+                self._check_enough_tpu_hosts()
+            self._workers = [
+                self._create_worker(rank)
+                for rank in range(strategy.num_workers)
+            ]
         if strategy.init_hook:
             self._ray.get([
                 w.execute.remote(strategy.init_hook) for w in self._workers
@@ -501,9 +522,12 @@ class RayLauncher:
     def teardown_workers(self) -> None:
         """Kill actors without restart (parity: ``ray_launcher.py:117-129``)
         — fail-fast is the reference's fault model (SURVEY.md §5): worker
-        death surfaces as a raised ``ray.get``, recovery belongs to Tune."""
-        for worker in self._workers:
-            self._ray.kill(worker, no_restart=True)
+        death surfaces as a raised ``ray.get``, recovery belongs to Tune.
+        Externally-owned workers are released, not killed — their
+        lifetime belongs to the caller."""
+        if self._external_workers is None:
+            for worker in self._workers:
+                self._ray.kill(worker, no_restart=True)
         self._workers = []
         if self.queue is not None:
             try:

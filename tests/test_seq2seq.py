@@ -88,13 +88,15 @@ def test_oversized_sequence_raises_at_trace_time():
 
 def test_decoder_remat_matches_plain():
     """cfg.remat wraps the decoder blocks too; outputs must be identical
-    (remat changes the backward schedule, never the math)."""
+    (remat changes the backward schedule, never the math). n_layers=1:
+    the equivalence is per-block, depth only multiplies trace time."""
     src = np.asarray([[3, 5, 7, 2]], np.int32)
     tgt = np.asarray([[1, 2, 3, 4]], np.int32)
-    plain = Seq2SeqTransformer(_cfg())
+    plain = Seq2SeqTransformer(_cfg(n_layers=1))
     variables = plain.init(jax.random.PRNGKey(0), src, tgt)
     remat = Seq2SeqTransformer(
-        _cfg(remat=True, remat_policy="dots_with_no_batch_dims"))
+        _cfg(n_layers=1, remat=True,
+             remat_policy="dots_with_no_batch_dims"))
 
     def loss(m, v):
         return jnp.sum(m.apply(v, src, tgt).astype(jnp.float32) ** 2)
