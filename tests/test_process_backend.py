@@ -139,21 +139,26 @@ class ExplodingModel(BoringModel):
 
 
 @pytest.mark.multiproc
-def test_worker_exception_fails_fast(tmp_path, shared_world):
+def test_worker_exception_fails_fast(tmp_path):
     """A worker raising must surface on the driver (fail-fast fault model,
-    parity ``util.py:57-70``), not hang the launch. Runs on the shared
-    world: the exception happens before any fit state exists, and the
-    release-not-kill teardown of external workers leaves the world
-    healthy for later tests (itself a property worth covering)."""
-    ray_mod, workers = shared_world
+    parity ``util.py:57-70``), not hang the launch. Deliberately NOT on
+    the shared world: failure injection belongs in a disposable world —
+    an asymmetric failure mid-collective would wedge a shared one (the
+    release-not-kill teardown of external workers keeps the stuck actor
+    alive), and this fresh world also keeps the actors-killed-on-failure
+    teardown path itself covered."""
+    ray_mod = _make_backend()
+    ray_mod.init()
     strategy = RayStrategy(num_workers=2)
     trainer = Trainer(strategy=strategy, max_epochs=1, seed=0,
                       limit_train_batches=2, limit_val_batches=0,
                       default_root_dir=str(tmp_path))
-    trainer._launcher = RayLauncher(strategy, ray_module=ray_mod,
-                                    workers=workers)
-    with pytest.raises(RuntimeError, match="boom in worker"):
-        trainer.fit(ExplodingModel(batch_size=8))
+    trainer._launcher = RayLauncher(strategy, ray_module=ray_mod)
+    try:
+        with pytest.raises(RuntimeError, match="boom in worker"):
+            trainer.fit(ExplodingModel(batch_size=8))
+    finally:
+        ray_mod.shutdown()
 
 
 def _meet_at_files(dirpath: str, my_id: int, other_id: int,
@@ -259,8 +264,9 @@ def test_two_process_two_devices_dp_fsdp(tmp_path):
     from ray_lightning_tpu import MeshStrategy
 
     env = dict(WORKER_ENV)
-    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=2 "
-                        "--xla_backend_optimization_level=1")
+    # same flags as every other child, with only the device count changed
+    env["XLA_FLAGS"] = WORKER_ENV["XLA_FLAGS"].replace(
+        "device_count=1", "device_count=2")
     ray_mod = ProcessRay(worker_env=env)
     ray_mod.init()
     # num_workers=2 actors (hosts); the mesh spans 2x2=4 global devices
