@@ -69,6 +69,33 @@ def test_setup_workers_creates_actor_per_worker():
     assert len(fake.killed_actors) == 3
 
 
+def test_external_workers_reused_and_released():
+    """The persistent-workers seam (``RayLauncher(..., workers=)``):
+    setup adopts the caller's actors instead of creating, teardown
+    releases instead of killing, and a count mismatch raises before any
+    work is dispatched (a wrong-size world would wedge at rendezvous)."""
+    strategy = rlt.RayStrategy(num_workers=2)
+    _, fake = _make_launcher(strategy)
+    external = [fake.remote(RecordingExecutor).remote() for _ in range(2)]
+    n_created = len(fake.created_actors)
+
+    reuse = RayLauncher(strategy, ray_module=fake, workers=external)
+    reuse.setup_workers()
+    assert reuse._workers == external
+    assert len(fake.created_actors) == n_created  # no new actors created
+    reuse.teardown_workers()
+    assert fake.killed_actors == []  # external workers NOT killed
+    assert reuse._workers == []
+    # ...and the same world is adoptable again (the reuse the seam is for)
+    again = RayLauncher(strategy, ray_module=fake, workers=external)
+    again.setup_workers()
+    assert again._workers == external
+
+    with pytest.raises(ValueError, match="external workers"):
+        RayLauncher(rlt.RayStrategy(num_workers=3), ray_module=fake,
+                    workers=external)
+
+
 def test_coordinator_env_broadcast():
     """Coordinator chosen from worker 0's node, broadcast to all actors.
 
