@@ -39,7 +39,7 @@ def make_optimizer(name: str = "adamw",
                    *,
                    weight_decay: float = 0.0,
                    b1: float = 0.9,
-                   b2: float = 0.999,
+                   b2: Optional[float] = None,
                    moment_dtype: Optional[Any] = None,
                    factored: Optional[bool] = None
                    ) -> optax.GradientTransformation:
@@ -54,6 +54,13 @@ def make_optimizer(name: str = "adamw",
       (~2 bytes/param + rank-1 vectors). Largest saving; different
       optimizer family (update-norm clipping instead of bias
       correction), so re-check convergence when switching.
+
+    ``b2=None`` (the default) means "the preset's default" (0.999 for
+    the adam presets; not applicable to the factored branch). An
+    *explicit* ``b2`` is **ignored** on the adafactor/factored branch —
+    adafactor's second-moment decay is its own step-dependent schedule
+    (``1 - step**-0.8``), not an adam beta, so there is nothing for it
+    to map onto — and warns rather than silently dropping it.
     """
     if name not in OPTIMIZER_NAMES:
         raise ValueError(
@@ -70,6 +77,14 @@ def make_optimizer(name: str = "adamw",
         # NB: adafactor's decay_rate is the exponent of its step-dependent
         # second-moment schedule (1 - step^-0.8), NOT an adam beta — b2
         # deliberately does not map onto it
+        if b2 is not None:
+            import warnings
+
+            warnings.warn(
+                f"b2={b2} is ignored by the factored (adafactor) branch: "
+                "its second-moment decay is the built-in step schedule "
+                "1 - step**-0.8, not an adam beta",
+                stacklevel=2)
         return optax.adafactor(
             learning_rate=learning_rate,
             momentum=b1,
@@ -88,7 +103,8 @@ def make_optimizer(name: str = "adamw",
     mu_dtype = moment_dtype
     if name == "adamw_bf16m" and mu_dtype is None:
         mu_dtype = jnp.bfloat16
-    return optax.adamw(learning_rate, b1=b1, b2=b2, mu_dtype=mu_dtype,
+    return optax.adamw(learning_rate, b1=b1,
+                       b2=0.999 if b2 is None else b2, mu_dtype=mu_dtype,
                        weight_decay=weight_decay)
 
 

@@ -66,8 +66,13 @@ class ExecutorBase:
     be absent); fakes subclass/duck-type it for tests.
     """
 
-    def set_env_var(self, key: str, value: str) -> None:
-        os.environ[key] = value
+    def set_env_var(self, key: str, value: Optional[str]) -> None:
+        """``None`` unsets — callers that stamp per-test state (e.g. the
+        multiproc suite's TL_RANK) can restore a clean worker env."""
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
 
     def set_env_vars(self, keys: List[str], values: List[str]) -> None:
         for key, value in zip(keys, values):
@@ -148,15 +153,17 @@ class RayLauncher:
                 "RayLauncher requires `ray` (or an injected ray-compatible "
                 "module). Install ray, or use the default LocalLauncher for "
                 "single-host SPMD training.")
-        if not self._ray.is_initialized():
-            # Parity: ``ray_launcher.py:41-42`` — connect on first use.
-            self._ray.init()
-        self._external_workers = workers
+        # Validate before connecting: a mismatched call must not
+        # side-effect a live Ray connection on its way to raising.
         if workers is not None and len(workers) != strategy.num_workers:
             raise ValueError(
                 f"{len(workers)} external workers for a strategy needing "
                 f"num_workers={strategy.num_workers}; persistent worlds "
                 "must keep the same process count")
+        if not self._ray.is_initialized():
+            # Parity: ``ray_launcher.py:41-42`` — connect on first use.
+            self._ray.init()
+        self._external_workers = workers
         self._workers: List[Any] = []
         self._tpu_request: Optional[int] = None
         self._coordinator_address: Optional[str] = None

@@ -80,8 +80,12 @@ class GPTModule(TpuModule):
         # the optimizer-state HBM that forces large models into slow
         # layouts on one chip — see core/optim.py
         from ray_lightning_tpu.core.optim import make_optimizer
+        # b2=0.95 applies to the adam presets; the factored branch runs
+        # its own second-moment schedule and warns when b2 is forced on
+        # it, so only pass it where it means something
+        kwargs = {} if self.optimizer == "adafactor" else {"b2": 0.95}
         return make_optimizer(self.optimizer, self.lr,
-                              weight_decay=self.weight_decay, b2=0.95)
+                              weight_decay=self.weight_decay, **kwargs)
 
     def _loader(self, seed: int, shuffle: bool = False):
         toks = synthetic_tokens(self.num_samples, self.seq_len + 1,

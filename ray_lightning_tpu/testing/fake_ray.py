@@ -264,12 +264,15 @@ class RecordingExecutor:
         return []
 
     # --- executor protocol --------------------------------------------- #
-    def set_env_var(self, key: str, value: str) -> None:
-        self.env[key] = value
+    def set_env_var(self, key: str, value: Optional[str]) -> None:
+        if value is None:
+            self.env.pop(key, None)
+        else:
+            self.env[key] = value
 
     def set_env_vars(self, keys: List[str], values: List[str]) -> None:
         for k, v in zip(keys, values):
-            self.env[k] = v
+            self.set_env_var(k, v)
 
     def get_env_var(self, key: str) -> Optional[str]:
         return self.env.get(key)

@@ -106,3 +106,21 @@ def test_factored_override_is_honored():
 def test_unknown_name_raises():
     with pytest.raises(ValueError, match="unknown optimizer"):
         make_optimizer("sgd", 1e-3)
+
+
+def test_adafactor_warns_on_ignored_b2():
+    """ADVICE r4: a user tuning b2 on the factored branch must get a
+    signal that it was ignored (adafactor's second-moment decay is its
+    own step schedule, not an adam beta). b2=None (the default) means
+    'preset default' and stays silent; ANY explicit value — even the
+    adam default 0.999 — warns."""
+    with pytest.warns(UserWarning, match="b2=0.95 is ignored"):
+        make_optimizer("adafactor", 1e-3, b2=0.95)
+    with pytest.warns(UserWarning, match="b2=0.999 is ignored"):
+        make_optimizer("adafactor", 1e-3, b2=0.999)
+    import warnings as _w
+    with _w.catch_warnings():
+        # scoped to UserWarning: a dependency DeprecationWarning must
+        # not fail the b2 contract under test
+        _w.simplefilter("error", UserWarning)
+        make_optimizer("adafactor", 1e-3)

@@ -471,14 +471,25 @@ def test_host_local_batch_feeding_two_processes(tmp_path, shared_world):
     strategy = RayStrategy(num_workers=2)
     launcher = RayLauncher(strategy, ray_module=ray_mod, workers=workers)
     launcher.setup_workers(tune_enabled=False)
-    for rank, w in enumerate(launcher._workers):
-        ray_mod.get(w.set_env_var.remote("TL_RANK", str(rank)))
-    futures = [
-        w.execute.remote(_host_local_feed_worker, 7, 16, 8)
-        for w in launcher._workers
-    ]
-    results = ray_mod.get(futures)
-    launcher.teardown_workers()
+    try:
+        for rank, w in enumerate(launcher._workers):
+            ray_mod.get(w.set_env_var.remote("TL_RANK", str(rank)))
+        futures = [
+            w.execute.remote(_host_local_feed_worker, 7, 16, 8)
+            for w in launcher._workers
+        ]
+        results = ray_mod.get(futures)
+    finally:
+        # the shared world's actors persist across tests — don't leak
+        # per-test rank stamps into whatever adopts the world next
+        # (best-effort: a dead actor must not mask the real failure
+        # or skip the teardown below)
+        for w in launcher._workers:
+            try:
+                ray_mod.get(w.set_env_var.remote("TL_RANK", None))
+            except Exception:
+                pass
+        launcher.teardown_workers()
     for got, want in results:
         np.testing.assert_allclose(got, want, rtol=1e-5)
 

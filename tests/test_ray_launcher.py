@@ -94,6 +94,13 @@ def test_external_workers_reused_and_released():
     with pytest.raises(ValueError, match="external workers"):
         RayLauncher(rlt.RayStrategy(num_workers=3), ray_module=fake,
                     workers=external)
+    # ADVICE r4: the mismatch must raise BEFORE connecting — a fresh
+    # (uninitialized) ray module stays untouched by the failed ctor
+    fresh = FakeRay()
+    with pytest.raises(ValueError, match="external workers"):
+        RayLauncher(rlt.RayStrategy(num_workers=3), ray_module=fresh,
+                    workers=external)
+    assert not fresh.is_initialized()
 
 
 def test_coordinator_env_broadcast():
