@@ -1,0 +1,166 @@
+"""GPT-2-large (774M) at real dimensions: the FSDP memory-sharding proof.
+
+The reference demonstrates its memory-sharding claim by training a
+16-layer embed-2048 ImageGPT under RayShardedStrategy
+(``examples/ray_ddp_sharded_example.py:60-99``). The TPU-native analog is
+measured here, at GPT-2-large's actual dimensions, two ways:
+
+1. **Abstract accounting** (no arrays materialized): ``jax.eval_shape``
+   over the full 36-layer model + optimizer init, and per-device byte
+   counts taken from the *actual* ``NamedSharding.shard_shape`` of every
+   leaf under the strategy's sharding — the same layout XLA compiles.
+   Asserts the single-chip AdamW train state cannot leave a workable
+   activation budget on a 16 GiB v5e, while dp×fsdp=8 shards it below
+   2 GiB/device.
+
+2. **Executed step at full width**: one real train step of a
+   width-faithful large config (full d_model=1280, n_heads=20,
+   d_ff=5120, vocab=50257; depth reduced to 2 layers) under dp2×fsdp4 on
+   the 8-device virtual mesh, then asserts the per-device parameter
+   shard bytes match the accounting's prediction — tying the arithmetic
+   to an actually-executed layout.
+
+Measured context (docs/performance.md): the single-chip probe of true
+GPT-2-large OOMed at every layout on the real 16 GiB chip, including
+adafactor + scan + remat; its activation/workspace floor (≥6.8 GiB)
+exceeds the ~4.5 GiB the AdamW state leaves free.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_lightning_tpu import MeshStrategy, Trainer
+from ray_lightning_tpu.core.optim import make_optimizer
+from ray_lightning_tpu.models.gpt import GPTModule, gpt2_config
+from ray_lightning_tpu.models.transformer import TransformerLM
+
+V5E_HBM = 16 * 2**30  # bytes
+
+
+def _abstract_train_state(optimizer: str):
+    """(params, opt_state) as ShapeDtypeStruct trees for full gpt2-large.
+
+    eval_shape only — 774M params x4 states would be ~12 GiB of real
+    host arrays otherwise.
+    """
+    cfg = gpt2_config("large")  # 36 layers, d1280, 20 heads, vocab 50257
+    model = TransformerLM(cfg)
+    tokens = jax.ShapeDtypeStruct((1, cfg.max_seq_len), jnp.int32)
+    variables = jax.eval_shape(model.init, jax.random.PRNGKey(0), tokens)
+    params = variables["params"]
+    tx = make_optimizer(optimizer, 3e-4)
+    opt_state = jax.eval_shape(tx.init, params)
+    return params, opt_state
+
+
+def _tree_bytes(tree) -> int:
+    return sum(
+        math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape"))
+
+
+def _sharded_tree_bytes(tree, shardings) -> int:
+    """Per-device bytes under a sharding tree, from shard_shape — the
+    exact per-chip buffer XLA lays out, non-divisible dims included."""
+    total = 0
+    for leaf, s in zip(jax.tree_util.tree_leaves(tree),
+                       jax.tree_util.tree_leaves(shardings)):
+        total += (math.prod(s.shard_shape(leaf.shape))
+                  * jnp.dtype(leaf.dtype).itemsize)
+    return total
+
+
+def test_gpt2_large_state_accounting_single_chip_vs_fsdp8():
+    """The round-4 arithmetic, as executable evidence: AdamW train state
+    for 774M params monopolizes a 16 GiB chip; fsdp=8 shards it to
+    <2 GiB/device with >14 GiB left for activations."""
+    params, opt_state = _abstract_train_state("adamw")
+    n_params = sum(math.prod(l.shape)
+                   for l in jax.tree_util.tree_leaves(params))
+    assert 7.6e8 < n_params < 7.9e8, f"not gpt2-large: {n_params:.3g}"
+
+    param_bytes = _tree_bytes(params)
+    # peak train state: params + grads (same tree, live at the update)
+    # + AdamW mu & nu = 16 bytes/param ≈ 11.5 GiB
+    single_chip_peak = 2 * param_bytes + _tree_bytes(opt_state)
+    assert single_chip_peak > 11 * 2**30, (
+        f"{single_chip_peak/2**30:.2f} GiB peak state — expected the "
+        "AdamW state alone to claim ~72% of HBM")
+    headroom = V5E_HBM - single_chip_peak
+    # the measured single-chip activation/workspace floor exceeds this
+    # remainder: the real-chip probe (performance.md, commit b08c98a)
+    # OOMed at every layout with only ~9.2 GiB of adafactor state
+    # resident, i.e. the floor is ≥ 16 − 9.2 ≈ 6.8 GiB even at bs2 +
+    # chunked loss + full remat — far above AdamW's ≤5 GiB remainder
+    assert headroom < 5 * 2**30
+
+    strategy = MeshStrategy(axes={"dp": 1, "fsdp": 8})
+    p_shard = strategy.params_sharding(params)
+    o_shard = strategy.opt_state_sharding(opt_state)
+    per_device_peak = (2 * _sharded_tree_bytes(params, p_shard)
+                       + _sharded_tree_bytes(opt_state, o_shard))
+    assert per_device_peak < 2 * 2**30, (
+        f"{per_device_peak/2**30:.2f} GiB/device under fsdp=8")
+    # every major leaf divides by 8 (d_model/d_ff/vocab-embedding dims),
+    # so sharding must deliver near-ideal 8x state reduction
+    assert per_device_peak < single_chip_peak / 7.5
+    assert V5E_HBM - per_device_peak > 14 * 2**30
+
+
+def test_gpt2_large_state_accounting_adafactor():
+    """The single-chip rescue attempt, quantified: adafactor shrinks the
+    persistent state (factored nu + bf16 mu) but grads + master params
+    still leave less than half the chip for activations at large scale —
+    consistent with the measured single-chip OOM — while fsdp=8 over the
+    same state is a rounding error (<1 GiB/device)."""
+    params, opt_state = _abstract_train_state("adafactor")
+    param_bytes = _tree_bytes(params)
+    peak = 2 * param_bytes + _tree_bytes(opt_state)
+    # ~7.9 GiB: params 3.1 + grads 3.1 + bf16 mu 1.55 + factored vectors
+    assert 7 * 2**30 < peak < 9 * 2**30
+    strategy = MeshStrategy(axes={"dp": 1, "fsdp": 8})
+    per_device = (2 * _sharded_tree_bytes(params,
+                                          strategy.params_sharding(params))
+                  + _sharded_tree_bytes(
+                      opt_state, strategy.opt_state_sharding(opt_state)))
+    assert per_device < 1 * 2**30
+
+
+def test_gpt2_large_width_faithful_step_fsdp():
+    """One executed train step at GPT-2-large's full width (d_model 1280,
+    20 heads, d_ff 5120, vocab 50257; 2 of 36 layers) under dp2×fsdp4 —
+    and the executed per-device parameter shard bytes must equal the
+    accounting's shard_shape prediction exactly."""
+    cfg = gpt2_config("large", max_seq_len=128, n_layers=2)
+    module = GPTModule(config=cfg, batch_size=8, seq_len=128,
+                       num_samples=16, lr=1e-3, optimizer="adafactor")
+    strategy = MeshStrategy(axes={"dp": 2, "fsdp": 4})
+    trainer = Trainer(strategy=strategy, max_epochs=1,
+                      limit_train_batches=1, limit_val_batches=0,
+                      num_sanity_val_steps=0, enable_checkpointing=False)
+    trainer.fit(module)
+    assert trainer.global_step == 1
+    params = trainer.train_state.params
+    jax.block_until_ready(params)
+
+    executed = sum(
+        math.prod(leaf.sharding.shard_shape(leaf.shape))
+        * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params))
+    abstract = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), params)
+    predicted = _sharded_tree_bytes(
+        abstract, strategy.params_sharding(abstract))
+    assert executed == predicted
+    # fsdp=4 shards the full-width matrices 4x: per-device params must
+    # sit well under half the replicated total
+    assert executed < _tree_bytes(abstract) / 3
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))
