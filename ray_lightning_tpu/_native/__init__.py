@@ -98,6 +98,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.tlshm_push.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_double
         ]
+        lib.tlshm_push_v.restype = ctypes.c_int
+        lib.tlshm_push_v.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.c_double
+        ]
         lib.tlshm_peek.restype = ctypes.c_int64
         lib.tlshm_peek.argtypes = [ctypes.c_void_p, ctypes.c_double]
         lib.tlshm_pop.restype = ctypes.c_int64
@@ -161,8 +167,40 @@ class ShmRing:
                 f"message of {len(data)} bytes exceeds half the ring "
                 "capacity; enlarge the ring")
 
-    def pop(self, timeout: float = 10.0) -> Optional[bytes]:
-        """Next message, or None when the ring is closed and drained."""
+    def push_buffers(self, buffers, timeout: float = 10.0) -> None:
+        """Scatter-gather push: one message assembled from several buffer-
+        protocol segments (bytes, memoryviews, numpy arrays), each memcpy'd
+        straight from its own memory into the ring — no concatenated bytes
+        detour. This is what makes pickle-5 out-of-band batch transport a
+        single producer-side copy (see ``data/multiproc.py``).
+        """
+        import numpy as np
+
+        n = len(buffers)
+        # np.frombuffer works for read-only and writable buffers alike and
+        # exposes a stable data pointer; the `views` list keeps every
+        # segment alive across the native call.
+        views = [np.frombuffer(b, dtype=np.uint8) for b in buffers]
+        ptrs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
+        lens = (ctypes.c_uint64 * n)(*[v.nbytes for v in views])
+        rc = self._lib.tlshm_push_v(self._h, ptrs, lens, n, timeout)
+        if rc == -1:
+            raise TimeoutError("ring full")
+        if rc == -2:
+            raise BrokenPipeError("ring closed")
+        if rc == -3:
+            total = sum(v.nbytes for v in views)
+            raise ValueError(
+                f"message of {total} bytes exceeds half the ring "
+                "capacity; enlarge the ring")
+
+    def pop_view(self, timeout: float = 10.0) -> Optional[memoryview]:
+        """Next message as a writable memoryview over a freshly allocated
+        buffer (one shm→host copy, no extra bytes copy), or None when the
+        ring is closed and drained. The view owns the buffer: slices of it
+        (e.g. numpy arrays reconstructed zero-copy by pickle-5) stay valid
+        as long as they are referenced.
+        """
         size = self._lib.tlshm_peek(self._h, timeout)
         if size == -2:
             return None
@@ -176,7 +214,12 @@ class ShmRing:
             raise TimeoutError("ring empty")
         if n < 0:
             raise OSError(f"ring pop failed ({n})")
-        return buf.raw[:n]
+        return memoryview(buf)[:int(n)]
+
+    def pop(self, timeout: float = 10.0) -> Optional[bytes]:
+        """Next message, or None when the ring is closed and drained."""
+        view = self.pop_view(timeout)
+        return None if view is None else view.tobytes()
 
     def __len__(self) -> int:
         return int(self._lib.tlshm_count(self._h))

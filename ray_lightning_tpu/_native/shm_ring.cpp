@@ -161,13 +161,27 @@ static bool fits_locked(const Header* h, uint64_t fb) {
   return head >= fb;  // wrap: the [tail, capacity) gap is retired as used
 }
 
+int tlshm_push_v(void* handle, const char* const* bufs,
+                 const uint64_t* lens, uint64_t n_bufs, double timeout_s);
+
 // Push one message. 0 = ok, -1 = timeout, -2 = closed, -3 = too large.
 int tlshm_push(void* handle, const char* buf, uint64_t n, double timeout_s) {
+  return tlshm_push_v(handle, &buf, &n, 1, timeout_s);
+}
+
+// Scatter-gather push: one framed message assembled from n_bufs segments,
+// memcpy'd straight from caller memory into the ring. This is the
+// zero-detour batch path: the Python side hands the pickle-5 meta plus the
+// raw numpy array buffers as segments, so array bytes cross exactly once
+// (producer memory -> shm) instead of detouring through a concatenated
+// bytes object first. Same return codes as tlshm_push.
+int tlshm_push_v(void* handle, const char* const* bufs,
+                 const uint64_t* lens, uint64_t n_bufs, double timeout_s) {
   Ring* r = static_cast<Ring*>(handle);
   Header* h = r->hdr;
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < n_bufs; ++i) n += lens[i];
   uint64_t fb = frame_bytes(n);
-  // fb <= capacity/2 guarantees a drained ring can always place the
-  // message regardless of where head/tail happen to sit.
   if (fb * 2 > h->capacity) return -3;
 
   timespec deadline;
@@ -186,14 +200,17 @@ int tlshm_push(void* handle, const char* buf, uint64_t n, double timeout_s) {
   }
   uint64_t tail = h->tail;
   if (h->capacity - tail < fb) {
-    // Not enough contiguous room: mark the remainder skipped, wrap.
     if (h->capacity - tail >= 8)
       std::memcpy(r->data + tail, &WRAP_MARKER, 8);
     h->used += h->capacity - tail;
     tail = 0;
   }
   std::memcpy(r->data + tail, &n, 8);
-  std::memcpy(r->data + tail + 8, buf, n);
+  uint64_t off = tail + 8;
+  for (uint64_t i = 0; i < n_bufs; ++i) {
+    std::memcpy(r->data + off, bufs[i], lens[i]);
+    off += lens[i];
+  }
   h->tail = (tail + fb) % h->capacity;
   h->used += fb;
   h->n_messages += 1;

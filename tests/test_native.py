@@ -126,6 +126,55 @@ def test_ring_cross_process():
         r.destroy()
 
 
+@needs_native
+def test_ring_scatter_gather_zero_copy():
+    """The pickle-5 batch path: ``push_buffers`` writes each segment
+    straight into the ring (no concatenated bytes detour) and the consumer
+    reconstructs numpy arrays as zero-copy windows into the ONE buffer
+    ``pop_view`` allocated — the round-5 fix for the 0.48 forced-ring
+    transport ratio (arrays used to be copied ~4 extra times per batch).
+    """
+    from ray_lightning_tpu.data.multiproc import (_pack_frames,
+                                                  _unpack_frames)
+    r = ShmRing(f"/tl_t_{os.getpid()}_sg", capacity=1 << 22)
+    try:
+        x = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+        y = np.arange(64, dtype=np.int64)
+        r.push_buffers(_pack_frames(("batch", (x, y))))
+        view = r.pop_view()
+        kind, (gx, gy) = _unpack_frames(view)
+        assert kind == "batch"
+        np.testing.assert_array_equal(gx, x)
+        np.testing.assert_array_equal(gy, y)
+        # zero-copy contract: the reconstructed arrays are windows into
+        # the popped buffer, not fresh allocations
+        backing = np.frombuffer(view, dtype=np.uint8)
+        assert np.shares_memory(gx, backing)
+        assert np.shares_memory(gy, backing)
+        # no-buffer objects (e.g. the error tuple) round-trip too
+        r.push_buffers(_pack_frames(("error", "boom", "trace")))
+        assert _unpack_frames(r.pop_view()) == ("error", "boom", "trace")
+    finally:
+        r.destroy()
+
+
+@needs_native
+def test_ring_scatter_gather_wraparound():
+    """push_buffers honors the same wrap-marker framing as push: messages
+    assembled from segments survive many trips around a small ring."""
+    from ray_lightning_tpu.data.multiproc import (_pack_frames,
+                                                  _unpack_frames)
+    r = ShmRing(f"/tl_t_{os.getpid()}_sgwrap", capacity=1 << 14)
+    try:
+        for i in range(40):
+            arr = np.full((13 + (i % 7), 11), i, dtype=np.int32)
+            r.push_buffers(_pack_frames(arr), timeout=30)
+            got = _unpack_frames(r.pop_view(timeout=30))
+            np.testing.assert_array_equal(got, arr)
+    finally:
+        r.destroy()
+
+
 def _make_loader(n=64, batch=8, shuffle=True):
     x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
     y = np.arange(n, dtype=np.int32)
