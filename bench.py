@@ -147,6 +147,102 @@ def _assemble_step(strategy, model, tx, loss_fn, init_batch, batch):
     return step, state, batch
 
 
+def _build_anchor_step():
+    """FROZEN cross-round anchor workload — raw jax, zero framework code.
+
+    DO NOT MODIFY (recorded round 5): the headline's cross-session
+    comparability rests on this exact computation. The axon tunnel adds
+    ±5% run-to-run jitter that an absolute samples/s number inherits
+    (round-4 VERDICT weak #2: the headline read 0.959 purely from
+    session conditions). This anchor rides the *same* session as the
+    headline measurement, so the ratio headline/anchor cancels the
+    shared jitter; ``vs_baseline`` compares anchored ratios across
+    rounds instead of raw rates.
+
+    Same shapes as the headline (784→128→256→10 MLP, batch 8192) so the
+    two workloads stress the chip and tunnel identically; plain
+    handwritten SGD so no library change can drift it.
+    """
+    import jax
+    import jax.numpy as jnp
+    from typing import NamedTuple
+
+    class AnchorState(NamedTuple):
+        params: tuple
+
+    rng = np.random.default_rng(1234)
+    dims = [784, 128, 256, 10]
+    params = tuple(
+        (jnp.asarray(rng.standard_normal((i, o)) * (1.0 / math.sqrt(i)),
+                     jnp.float32), jnp.zeros((o,), jnp.float32))
+        for i, o in zip(dims[:-1], dims[1:]))
+    x = jnp.asarray(rng.standard_normal((8192, 784)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(8192,)), jnp.int32)
+
+    def loss_fn(params, batch):
+        bx, by = batch
+        h = bx
+        for w, b in params[:-1]:
+            h = jnp.maximum(h @ w + b, 0.0)
+        w, b = params[-1]
+        logits = h @ w + b
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        return jnp.mean(logz - jnp.take_along_axis(
+            logits, by[:, None], axis=-1)[:, 0])
+
+    def step(state, batch):
+        grads = jax.grad(loss_fn)(state.params, batch)
+        new = jax.tree_util.tree_map(
+            lambda p, g: p - 1e-3 * g, state.params, grads)
+        return AnchorState(new), {}
+
+    return step, AnchorState(params), (x, y)
+
+
+def bench_headline_interleaved(pairs: int = 8) -> tuple[dict, dict]:
+    """Headline MNIST measurement interleaved with the frozen anchor.
+
+    Alternates full ``_measure_rate`` passes A/B/A/B… in one session so
+    both workloads see the same tunnel/host noise field; best-of each
+    side is the least-interfered pass. Returns (headline, anchor) dicts;
+    headline carries ``vs_anchor`` — the jitter-cancelled number the
+    scoreboard compares across rounds.
+    """
+    import jax
+
+    from ray_lightning_tpu import RayStrategy
+
+    n_chips = len(jax.devices())
+    strategy = RayStrategy(num_workers=n_chips, use_tpu=True)
+    fw_step, fw_state, fw_batch = _build_mnist_step(strategy,
+                                                    batch_size=8192)
+    an_step, an_state, an_batch = _build_anchor_step()
+    fw_flops = _step_flops(fw_step, fw_state, fw_batch)
+    an_flops = _step_flops(jax.jit(an_step), an_state, an_batch)
+    chip_peak = _chip_peak_flops(jax.devices()[0])
+    fw_peak = chip_peak * n_chips if chip_peak else None
+
+    fw_best = an_best = None
+    for _ in range(pairs):
+        cand = _measure_rate(fw_step, fw_state, fw_batch, 8192, fw_flops,
+                             fw_peak)
+        if fw_best is None or cand["samples_per_sec"] > \
+                fw_best["samples_per_sec"]:
+            fw_best = cand
+        cand = _measure_rate(an_step, an_state, an_batch, 8192, an_flops,
+                             chip_peak)
+        if an_best is None or cand["samples_per_sec"] > \
+                an_best["samples_per_sec"]:
+            an_best = cand
+    fw_best["samples_per_sec_per_chip"] = (
+        fw_best["samples_per_sec"] / n_chips)
+    fw_best["n_chips"] = n_chips
+    fw_best["device_kind"] = jax.devices()[0].device_kind
+    fw_best["vs_anchor"] = (fw_best["samples_per_sec_per_chip"]
+                            / an_best["samples_per_sec"])
+    return fw_best, an_best
+
+
 def _build_mnist_step(strategy, batch_size: int):
     import optax
 
@@ -426,7 +522,7 @@ def _run_scaling_child(dp: int) -> dict:
 
 
 def _bench_decode(batch: int = 8, prompt: int = 16,
-                  new_tokens: int = 64) -> dict:
+                  new_tokens: int = 256, short_tokens: int = 64) -> dict:
     """KV-cache autoregressive decode throughput (GPT-2-small, greedy).
 
     The whole prompt-feed + sample loop is ONE jitted ``lax.scan``
@@ -435,6 +531,16 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     Params are served in bf16 (standard inference practice): each decode
     step reads every weight, so f32 masters would double the per-step
     HBM traffic that bounds small-batch decode.
+
+    Round-5 protocol (VERDICT #5): round 4's device trace showed the
+    64-token wall number was ~50% fixed dispatch cost (0.68 ms/step
+    device vs 1.405 ms wall) — an artifact of generation length riding
+    a ~55 ms tunnel round-trip. Two fixes, both reported: (a) the wall
+    measurement now generates 256 tokens, amortizing the dispatch 4×;
+    (b) a differential between 256- and 64-token generations isolates
+    the marginal per-step cost — pure device time, dispatch cancels —
+    reported as ``device_ms_per_token_step`` with the fixed overhead
+    attributed in ``fixed_dispatch_ms``.
     """
     import jax
     import jax.numpy as jnp
@@ -455,26 +561,40 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     dec = TransformerLM(gpt2_config("small", decode=True,
                                     param_dtype=jnp.bfloat16, **base))
 
-    def run(rng):
-        return generate(dec, params, toks, max_new_tokens=new_tokens,
-                        rng=rng, temperature=0.0)
+    def make_runner(n: int):
+        def run(rng):
+            return generate(dec, params, toks, max_new_tokens=n,
+                            rng=rng, temperature=0.0)
+        runner = jax.jit(run)
+        jax.block_until_ready(runner(jax.random.PRNGKey(1)))  # compile
+        return runner
 
-    runner = jax.jit(run)
-    jax.block_until_ready(runner(jax.random.PRNGKey(1)))  # compile
-    # best-of-4: decode is a short measurement (one ~80-step generate per
-    # rep) and showed +-16% session spread across rounds (5161 r3-doc vs
-    # 3724 r3-bench) — more reps narrow the tunnel-jitter tail
-    best = float("inf")
-    for i in range(4):
+    run_long = make_runner(new_tokens)
+    run_short = make_runner(short_tokens)
+
+    def timed(runner, key) -> float:
         t0 = time.perf_counter()
-        out = runner(jax.random.PRNGKey(2 + i))
+        out = runner(key)
         jax.block_until_ready(out)
-        best = min(best, time.perf_counter() - t0)
+        return time.perf_counter() - t0
+
+    # Interleaved best-of-4 (the round-4 A/B discipline): decode showed
+    # ±16% session spread across rounds; alternating long/short gives
+    # both lengths the same noise field so the differential stays clean.
+    best_long = best_short = float("inf")
+    for i in range(4):
+        best_long = min(best_long, timed(run_long,
+                                         jax.random.PRNGKey(2 + i)))
+        best_short = min(best_short, timed(run_short,
+                                           jax.random.PRNGKey(20 + i)))
     # generate()'s scan runs total-1 single-token forward steps (prompt
     # feed + sampling share the same cached step); account each metric
     # against what was actually executed — steps for the steady-state
     # rate, sampled tokens for the end-to-end generation rate
     n_steps = total - 1
+    n_steps_short = prompt + short_tokens - 1
+    diff = best_long - best_short
+    diff_steps = n_steps - n_steps_short
     # Honesty guard (same contract as _measure_rate): a collapsed timing
     # must raise, never print. The floor IS the physical bound: every
     # decode step reads at least all params, so the run cannot finish
@@ -482,19 +602,31 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     # optimism), nor faster than the clock can resolve.
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     hbm_bw = _hbm_bandwidth(jax.devices()[0])
-    min_time = max(n_steps * (2 * n_params) / (1.5 * hbm_bw),
-                   1000 * time.get_clock_info("perf_counter").resolution)
-    if best < min_time:
+    step_floor = (2 * n_params) / (1.5 * hbm_bw)
+    resolution = 1000 * time.get_clock_info("perf_counter").resolution
+    if best_long < max(n_steps * step_floor, resolution):
         raise MeasurementError(
-            f"decode timing collapsed: {best:.2e}s for {n_steps} scan "
-            f"steps is below the param-bandwidth floor {min_time:.2e}s — "
-            "device elided work or async dispatch leaked")
+            f"decode timing collapsed: {best_long:.2e}s for {n_steps} "
+            f"scan steps is below the param-bandwidth floor — device "
+            "elided work or async dispatch leaked")
+    if diff < max(diff_steps * step_floor, resolution):
+        raise MeasurementError(
+            f"decode differential collapsed: {diff:.2e}s for "
+            f"{diff_steps} marginal steps is below the param-bandwidth "
+            "floor — the two lengths did not both execute")
+    device_ms = 1e3 * diff / diff_steps
     return {
         "model": "gpt2_small (bf16 serving params)", "batch": batch,
         "prompt": prompt, "new_tokens": new_tokens,
-        "token_steps_per_sec": round(batch * n_steps / best, 0),
-        "generated_tokens_per_sec": round(batch * new_tokens / best, 0),
-        "ms_per_token_step": round(1e3 * best / n_steps, 3),
+        "token_steps_per_sec": round(batch * n_steps / best_long, 0),
+        "generated_tokens_per_sec": round(
+            batch * new_tokens / best_long, 0),
+        "ms_per_token_step": round(1e3 * best_long / n_steps, 3),
+        "device_ms_per_token_step": round(device_ms, 3),
+        "device_token_steps_per_sec": round(
+            batch * 1e3 / device_ms, 0),
+        "fixed_dispatch_ms": round(
+            1e3 * best_long - device_ms * n_steps, 1),
     }
 
 
@@ -729,18 +861,21 @@ def main() -> None:
 
     extras: dict = {}
 
-    # best_of=8: the axon tunnel's run-to-run jitter was the round-2
-    # scoreboard's 0.963 regression marker (VERDICT weak #2); batch sweep
-    # re-verified 8192 as the throughput plateau (16384 equal, 32k/64k
-    # regress), so more repeats — not a bigger batch — is the honest lever
-    mnist = bench_model(_build_mnist_step, samples_per_step=8192,
-                        batch_size=8192, best_of=8)
+    # Interleaved A/B vs the frozen raw-jax anchor (round 5, VERDICT #2):
+    # 8 alternating pairs in one session — the anchored ratio vs_anchor is
+    # what the scoreboard compares across rounds, cancelling the tunnel's
+    # ±5% session jitter that made round 4's raw headline read 0.959.
+    # Batch sweep re-verified 8192 as the throughput plateau (16384 equal,
+    # 32k/64k regress).
+    mnist, anchor = bench_headline_interleaved(pairs=8)
     value = mnist["samples_per_sec_per_chip"]
     extras["mnist"] = {
         "samples_per_sec_per_chip": round(value, 1),
         "mfu": round(mnist["mfu"], 4) if mnist["mfu"] else None,
         "flops_per_step": mnist["flops_per_step"],
         "device_kind": mnist["device_kind"],
+        "anchor_samples_per_sec": round(anchor["samples_per_sec"], 1),
+        "vs_anchor": round(mnist["vs_anchor"], 4),
     }
 
     try:
@@ -840,8 +975,12 @@ def main() -> None:
     # Extras with their own reference anchor (round-3 VERDICT weak #4:
     # decode had no tracking, so a regression would be silent). Each gets
     # a vs_reference ratio next to its value — loud like the headline.
+    # decode tracks the device-differential rate (round 5): the wall rate
+    # changed meaning when new_tokens went 64→256 (less dispatch per
+    # step), so comparing it against a 64-token anchor would fabricate a
+    # win; the device number is protocol-independent.
     tracked_extras = {
-        "decode": "token_steps_per_sec",
+        "decode": "device_token_steps_per_sec",
         "data_pipeline": "speedup",
         "gpt2_small": "mfu",
         "gpt2_medium": "mfu",
@@ -851,15 +990,50 @@ def main() -> None:
         try:
             with open(REFERENCE_FILE) as f:
                 ref = json.load(f)
-            if ref.get("value"):
+            # Anchored comparison (round 5): both sides of the ratio are
+            # normalized by the frozen raw-jax anchor measured in their
+            # OWN session, so tunnel jitter cancels instead of reading as
+            # regression. Falls back to the raw-rate ratio when the
+            # reference predates the anchor.
+            ref_vs_anchor = ref.get("headline_vs_anchor")
+            if ref_vs_anchor and extras["mnist"].get("vs_anchor"):
+                vs_baseline = (extras["mnist"]["vs_anchor"]
+                               / float(ref_vs_anchor))
+            elif ref.get("value"):
                 vs_baseline = value / float(ref["value"])
+            raw_ratio = (value / float(ref["value"])
+                         if ref.get("value") else None)
+            if (not ref_vs_anchor and extras["mnist"].get("vs_anchor")
+                    and raw_ratio is not None
+                    and 0.93 <= raw_ratio <= 1.10):
+                # one-time upgrade: record this session's anchored pair so
+                # every later run compares jitter-free. Gated on the raw
+                # ratio sitting inside the known tunnel-jitter band — a
+                # genuinely regressed (or miraculous) session must NOT
+                # become the permanent baseline; it stays on the loud raw
+                # comparison and the next healthy session re-anchors.
+                ref["headline_vs_anchor"] = extras["mnist"]["vs_anchor"]
+                ref["anchor_recorded"] = "round 5 re-anchor"
+                with open(REFERENCE_FILE, "w") as f:
+                    json.dump(ref, f, indent=2)
             ref_extras = ref.get("extras", {})
+            ref_dirty = False
             for key, field in tracked_extras.items():
                 cur = extras.get(key, {}).get(field)
-                anchor = ref_extras.get(key, {}).get(field)
-                if cur is not None and anchor:
+                ref_val = ref_extras.get(key, {}).get(field)
+                if cur is not None and ref_val:
                     extras[key]["vs_reference"] = round(
-                        float(cur) / float(anchor), 3)
+                        float(cur) / float(ref_val), 3)
+                elif cur is not None and key in ref_extras:
+                    # protocol gained a field the anchor predates (e.g.
+                    # decode's device-differential rate): record the first
+                    # valid measurement so later runs compare against it
+                    ref_extras[key][field] = cur
+                    ref_extras[key][f"{field}_recorded"] = "round 5"
+                    ref_dirty = True
+            if ref_dirty:
+                with open(REFERENCE_FILE, "w") as f:
+                    json.dump(ref, f, indent=2)
         except (json.JSONDecodeError, KeyError, ValueError):
             pass
     else:
@@ -867,7 +1041,8 @@ def main() -> None:
             json.dump({
                 "metric": "samples/sec/chip (MNIST MLP train step)",
                 "value": round(value, 1),
-                "recorded": "first valid run (round 2)",
+                "recorded": "first valid run",
+                "headline_vs_anchor": extras["mnist"].get("vs_anchor"),
                 "extras": extras,
             }, f, indent=2)
 
