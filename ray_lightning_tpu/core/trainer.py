@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import time
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -727,7 +728,11 @@ class Trainer:
         model = self._model
         state_shardings = self._state_shardings
 
-        @jax.jit
+        # out_shardings replicates the predictions (an all-gather over the
+        # batch axis): under multi-controller SPMD the raw output is
+        # sharded across processes and rank 0 could not device_get its
+        # non-addressable shards. Single-process this is a no-op.
+        @partial(jax.jit, out_shardings=self.strategy.scalar_sharding())
         def predict_step(state, batch):
             return module.predict_step(model, state.variables, batch,
                                        state.rng)

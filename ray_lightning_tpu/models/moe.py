@@ -32,7 +32,8 @@ from jax.sharding import PartitionSpec as P
 from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.data.loader import ArrayDataset, DataLoader
 from ray_lightning_tpu.models.transformer import (MultiHeadAttention,
-                                                  TransformerConfig)
+                                                  TransformerConfig,
+                                                  maybe_remat)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -166,9 +167,13 @@ class MoeTransformerLM(nn.Module):
         x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wpe")(pos)
         aux_total = 0.0
+        # same remat seat as the dense stack (cfg.remat / cfg.remat_policy,
+        # incl. save_attn): deterministic is arg 3 of the block's __call__
+        block_cls = maybe_remat(MoeTransformerBlock, cfg,
+                                deterministic_argnum=3)
         for i in range(cfg.n_layers):
-            x, aux = MoeTransformerBlock(cfg, name=f"block_{i}")(
-                x, deterministic=deterministic)
+            x, aux = block_cls(cfg, name=f"block_{i}")(
+                x, None, deterministic)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = wte.attend(x)

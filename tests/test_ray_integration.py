@@ -82,6 +82,53 @@ WORKER_RUNTIME_ENV = {
 pytestmark = pytest.mark.ray_integration
 
 
+def test_ray_api_surface_audit():
+    """Every ray symbol the package (`tune.py`, `launchers/ray_launcher.py`,
+    `strategies/base.py`) or this suite touches must exist on the installed
+    ray — importable cheaply, BEFORE any cluster spins up. Purpose (round-4
+    VERDICT #3): the pinned job (2.9.3) proves the audit itself; when the
+    advisory latest-ray job fails HERE, the failure is upstream API churn
+    with the missing symbol named — not rot elsewhere in the tier.
+    """
+    import inspect
+
+    # core API, unconditional (1.x surface, used by the launcher/strategy)
+    for name in ("init", "get", "put", "wait", "remote", "kill",
+                 "shutdown", "is_initialized", "ObjectRef",
+                 "get_gpu_ids", "get_runtime_context"):
+        assert hasattr(ray, name), f"ray.{name} missing"
+    import ray.util
+    assert hasattr(ray.util, "get_node_ip_address")
+    from ray.util.queue import Queue
+    # RayLauncher passes actor_options= so the queue actor can be pinned
+    assert "actor_options" in inspect.signature(Queue).parameters
+
+    from ray import tune
+    assert hasattr(tune, "run")
+    run_params = inspect.signature(tune.run).parameters
+    for kw in ("metric", "mode", "resources_per_trial", "config",
+               "verbose"):
+        assert kw in run_params, f"tune.run({kw}=) missing"
+    # renamed local_dir → storage_path in 2.7; package version-gates on
+    # this exact pair, so at least one must exist
+    assert ("storage_path" in run_params or "local_dir" in run_params)
+
+    # session-reporting generations: tune.py probes new (ray.train) then
+    # legacy (ray.tune) — one complete generation must be present
+    import ray.train
+    new_gen = (hasattr(ray.train, "report")
+               and hasattr(ray.train, "Checkpoint"))
+    legacy_gen = hasattr(tune, "report")
+    assert new_gen or legacy_gen, (
+        "neither ray.train.report/Checkpoint (2.7+) nor tune.report "
+        "(legacy) exists — the tune session integration has no API to "
+        "bind to")
+    if new_gen:
+        # Checkpoint round trip contract used by live_tune_run test
+        assert hasattr(ray.train.Checkpoint, "from_directory")
+        assert hasattr(ray.train.Checkpoint, "as_directory")
+
+
 @pytest.fixture(scope="module", autouse=True)
 def _ray_module_teardown():
     yield

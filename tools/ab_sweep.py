@@ -1,0 +1,177 @@
+"""Interleaved A/B sweep harness for model-zoo levers on the real chip.
+
+The measurement discipline proven in round 4 on GPT-2 (docs/performance.md
+"Measurement integrity"), packaged: candidate configs are measured in
+alternating full passes within ONE session — A/B/A/B… — so the axon
+tunnel's session jitter hits every candidate equally and the RATIO between
+bests is trustworthy even when absolute rates drift.
+
+Round-5 use (VERDICT #6): sweep ``save_attn`` remat and the
+``make_optimizer`` presets over the ViT and MoE-LM families; results in
+docs/performance.md, winning defaults shipped in the examples.
+
+Usage (real chip):
+    python tools/ab_sweep.py vit
+    python tools/ab_sweep.py moe
+
+Prints one JSON line per candidate: {"name", "samples_per_sec", "best_of"}
+plus a final {"winner": ...} line with ratios vs the first (baseline)
+candidate.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(REPO, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _build_vit_step(strategy, batch_size: int, image_size: int = 224,
+                    patch_size: int = 16, **cfg_overrides):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.core.optim import make_optimizer
+    from ray_lightning_tpu.models.vit import ViTClassifier, vit_config
+
+    opt_name = cfg_overrides.pop("optimizer", "adamw")
+    cfg = vit_config("base", image_size=image_size, patch_size=patch_size,
+                     dtype=jnp.bfloat16, **cfg_overrides)
+    model = ViTClassifier(cfg, num_classes=1000, patch_size=patch_size)
+    tx = make_optimizer(opt_name, learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(
+        (batch_size, image_size, image_size, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, size=(batch_size,)), jnp.int32)
+
+    def loss_fn(params, model_state, batch, rng):
+        bx, by = batch
+        logits = model.apply({"params": params}, bx)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, by).mean()
+        return loss, ({}, model_state)
+
+    return bench._assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
+
+
+def _build_moe_step(strategy, batch_size: int, seq_len: int = 512,
+                    **cfg_overrides):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.core.optim import make_optimizer
+    from ray_lightning_tpu.models.moe import MoeTransformerLM, moe_config
+
+    opt_name = cfg_overrides.pop("optimizer", "adamw")
+    cfg = moe_config("small", vocab_size=50304, max_seq_len=seq_len,
+                     d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+                     n_experts=8, dtype=jnp.bfloat16, **cfg_overrides)
+    model = MoeTransformerLM(cfg)
+    tx = make_optimizer(opt_name, learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, 50257,
+                                    size=(batch_size, seq_len + 1)),
+                       jnp.int32)
+    x, y = toks[:, :-1], toks[:, 1:]
+
+    def loss_fn(params, model_state, batch, rng):
+        bx, by = batch
+        logits, aux = model.apply({"params": params}, bx,
+                                  False)  # deterministic=False
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, by).mean() + cfg.aux_loss_weight * aux
+        return loss, ({}, model_state)
+
+    return bench._assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
+
+
+SWEEPS = {
+    "vit": {
+        "build": _build_vit_step,
+        "batch_size": 64,
+        "candidates": [
+            ("no_remat", {}),
+            ("remat_dots_nb", {"remat": True,
+                               "remat_policy":
+                                   "dots_with_no_batch_dims"}),
+            ("remat_save_attn", {"remat": True,
+                                 "remat_policy":
+                                     "dots_with_no_batch_dims_save_attn"}),
+            ("no_remat_adafactor", {"optimizer": "adafactor"}),
+        ],
+    },
+    "moe": {
+        "build": _build_moe_step,
+        "batch_size": 16,
+        "candidates": [
+            ("no_remat", {}),
+            ("remat_dots_nb", {"remat": True,
+                               "remat_policy":
+                                   "dots_with_no_batch_dims"}),
+            ("remat_save_attn", {"remat": True,
+                                 "remat_policy":
+                                     "dots_with_no_batch_dims_save_attn"}),
+            ("no_remat_adafactor", {"optimizer": "adafactor"}),
+        ],
+    },
+}
+
+
+def run_sweep(which: str, pairs: int = 4) -> dict:
+    import jax
+
+    from ray_lightning_tpu import RayStrategy
+
+    spec = SWEEPS[which]
+    n_chips = len(jax.devices())
+    strategy = RayStrategy(num_workers=n_chips, use_tpu=True)
+    bs = spec["batch_size"]
+
+    built = []
+    for name, overrides in spec["candidates"]:
+        try:
+            step, state, batch = spec["build"](strategy, batch_size=bs,
+                                               **dict(overrides))
+            flops = bench._step_flops(step, state, batch)
+            built.append((name, step, state, batch, flops))
+        except Exception as exc:  # e.g. OOM at this layout: record, go on
+            print(json.dumps({"name": name,
+                              "error": f"{type(exc).__name__}: {exc}"}))
+    chip_peak = bench._chip_peak_flops(jax.devices()[0])
+    peak = chip_peak * n_chips if chip_peak else None
+
+    best: dict = {}
+    for _ in range(pairs):  # interleave full passes across ALL candidates
+        for name, step, state, batch, flops in built:
+            out = bench._measure_rate(step, state, batch, bs, flops, peak)
+            if name not in best or out["samples_per_sec"] > \
+                    best[name]["samples_per_sec"]:
+                best[name] = out
+    baseline = spec["candidates"][0][0]
+    report = {}
+    for name, out in best.items():
+        report[name] = {
+            "samples_per_sec": round(out["samples_per_sec"], 2),
+            "vs_baseline": round(out["samples_per_sec"]
+                                 / best[baseline]["samples_per_sec"], 4),
+        }
+        print(json.dumps({"name": name, **report[name]}))
+    winner = max(report, key=lambda k: report[k]["samples_per_sec"])
+    print(json.dumps({"winner": winner, "sweep": which,
+                      "batch_size": bs, "report": report}))
+    return report
+
+
+if __name__ == "__main__":
+    run_sweep(sys.argv[1] if len(sys.argv) > 1 else "vit")
