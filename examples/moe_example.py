@@ -29,6 +29,10 @@ def main():
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--max-epochs", type=int, default=3)
+    # adafactor measured +15.6% on-chip for MoE (expert params dominate
+    # optimizer-state traffic; see docs/performance.md round-5 sweep)
+    parser.add_argument("--optimizer", default="adafactor",
+                        choices=["adamw", "adamw_bf16m", "adafactor"])
     parser.add_argument("--smoke-test", action="store_true", default=False)
     args = parser.parse_args()
 
@@ -37,6 +41,7 @@ def main():
                      vocab_size=256)
     model = MoeModule(config=cfg, batch_size=args.batch_size,
                       seq_len=args.seq_len,
+                      optimizer=args.optimizer,
                       num_samples=4 * args.batch_size if args.smoke_test
                       else 32 * args.batch_size)
     trainer = Trainer(

@@ -214,7 +214,7 @@ class MoeModule(TpuModule):
     def __init__(self, config: MoeConfig | None = None, size: str = "nano",
                  batch_size: int = 8, seq_len: int = 64,
                  num_samples: int = 256, lr: float = 1e-3,
-                 vocab_size: int = 256):
+                 vocab_size: int = 256, optimizer: str = "adamw"):
         super().__init__()
         if config is None:
             config = moe_config(size, vocab_size=vocab_size,
@@ -224,12 +224,21 @@ class MoeModule(TpuModule):
         self.seq_len = min(seq_len, config.max_seq_len)
         self.num_samples = num_samples
         self.lr = lr
+        self.optimizer = optimizer
 
     def configure_model(self):
         return MoeTransformerLM(self.cfg)
 
     def configure_optimizers(self):
-        return optax.adamw(self.lr, weight_decay=0.01)
+        # ``optimizer="adafactor"`` measured +15.6% samples/s on the chip
+        # for an 8-expert/8-layer MoE LM (interleaved A/B, tools/
+        # ab_sweep.py): top-k routing touches 1/k of the expert FLOPs per
+        # step but the optimizer updates EVERY expert param, so state
+        # traffic is a larger share than on dense models. Kept opt-in
+        # (default adamw) because switching optimizer families is a
+        # modeling decision — see core/optim.py.
+        from ray_lightning_tpu.core.optim import make_optimizer
+        return make_optimizer(self.optimizer, self.lr, weight_decay=0.01)
 
     def _loader(self, seed: int, shuffle: bool = False):
         x, y = _synthetic_lm_tokens(self.num_samples, self.seq_len,

@@ -130,14 +130,42 @@ def replicated_pytree(tree: Any, mesh: Mesh) -> Any:
 
 
 def apply_rule(tree: Any, mesh: Mesh,
-               rule: Callable[[Tuple[Any, ...], Any], P]) -> Any:
+               rule: Callable[[Tuple[Any, ...], Any], P],
+               fallback_replicate: bool = False) -> Any:
     """Map a ``(path, leaf) -> PartitionSpec`` rule over a pytree.
 
     Used by tensor-parallel strategies where sharding depends on the
     parameter's role (e.g. attention qkv vs mlp down-projection).
+
+    ``fallback_replicate=True`` replicates any leaf whose shape cannot
+    satisfy the rule's spec instead of letting pjit reject it. This is
+    for DERIVED trees (optimizer state): name-matching rules see e.g.
+    adafactor's factored ``v_row['...']['experts_down']`` — a ``(1,)``
+    placeholder that matches the expert param rule by path but not by
+    shape. Parameters themselves keep the loud failure (a rule that
+    cannot shard a param is a bug, not a fallback case).
     """
+    def _spec_fits(spec: P, leaf) -> bool:
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            return True
+        if len(spec) > len(shape):
+            return False
+        for dim, names in zip(shape, spec):
+            if names is None:
+                continue
+            size = 1
+            for n in (names if isinstance(names, tuple) else (names,)):
+                size *= mesh.shape[n]
+            if dim % size:
+                return False
+        return True
+
     def _leaf(path, leaf):
-        return NamedSharding(mesh, rule(path, leaf))
+        spec = rule(path, leaf)
+        if fallback_replicate and not _spec_fits(spec, leaf):
+            spec = P()
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(_leaf, tree)
 

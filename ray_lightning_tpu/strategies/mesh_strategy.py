@@ -92,8 +92,13 @@ class MeshStrategy(Strategy):
             # Optimizer moments mirror the params pytree, so param paths
             # appear as suffixes of opt-state paths and the same rule
             # lands the same layout (scalars/counters match nothing → P()).
+            # fallback_replicate: factored states (adafactor v_row/v_col
+            # and their (1,) placeholders) match param paths by NAME but
+            # not by shape — those leaves replicate instead of tripping
+            # pjit's divisibility check.
             return shardlib.apply_rule(abstract_opt_state, mesh,
-                                       self._param_rule)
+                                       self._param_rule,
+                                       fallback_replicate=True)
         if FSDP_AXIS in mesh.axis_names and mesh.shape[FSDP_AXIS] > 1:
             return shardlib.shard_pytree_along_axis(abstract_opt_state, mesh,
                                                     FSDP_AXIS)

@@ -158,3 +158,30 @@ def test_dcn_matches_single_slice_numerics(tmp_root):
                     jax.tree_util.tree_leaves(b)):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=1e-6)
+
+
+def test_factored_opt_state_under_param_rule(tmp_root):
+    """adafactor + a name-matching param rule: the factored second-moment
+    leaves (v_row/v_col, incl. the (1,) placeholders optax stores for
+    non-factored params) match expert param PATHS but not shapes — they
+    must fall back to replication instead of tripping pjit's
+    divisibility check (round-5 /verify catch: the MoE example with
+    ``--optimizer adafactor`` crashed under ``dp2 x ep4``)."""
+    from ray_lightning_tpu.models.moe import (MoeModule,
+                                              expert_parallel_rule,
+                                              moe_config)
+
+    cfg = moe_config("nano", vocab_size=64, max_seq_len=32)
+    model = MoeModule(config=cfg, batch_size=8, seq_len=32,
+                      num_samples=32, optimizer="adafactor")
+    strategy = MeshStrategy(axes={"dp": 2, "ep": 4},
+                            param_rule=expert_parallel_rule)
+    trainer = get_trainer(tmp_root, strategy=strategy, max_epochs=1,
+                          limit_train_batches=2, limit_val_batches=0,
+                          checkpoint_callback=False)
+    trainer.fit(model)  # raised ValueError (indivisible (1,)) before
+    assert trainer.state == "finished"
+    # the expert param itself must still be ep-sharded (the fallback is
+    # per-leaf, not a blanket replication)
+    leaf = trainer.train_state.params["block_0"]["moe"]["experts_up"]
+    assert not leaf.sharding.is_fully_replicated
