@@ -81,6 +81,21 @@ class MeasurementError(RuntimeError):
     """A throughput measurement that cannot be trusted. Never clamped."""
 
 
+def _fetch_scalar(tree) -> float:
+    """Host-fetch one element of ``tree`` — THE completion barrier.
+
+    Under the axon tunnel ``jax.block_until_ready`` can return before
+    remote execution finishes (round 5: a 271-step decode "completed" in
+    2.7e-5 s); only fetching output data proves the work ran. Every
+    timed section must end with a fetch of something derived from its
+    output — use this helper, don't hand-roll the idiom.
+    """
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    return float(jax.device_get(leaf.ravel()[0]))
+
+
 def _lookup_by_kind(table: dict, device, default):
     """Single device-kind → spec-table matcher, shared by the FLOP and
     HBM-bandwidth bounds so new generations get added in one shape."""
@@ -595,8 +610,7 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
         # data is a real barrier; the second call drains residual
         # first-dispatch cost (~4 s observed) out of the timed reps
         for k in (1, 99):
-            jax.device_get(
-                runner(params, toks, jax.random.PRNGKey(k))[:, -1])
+            _fetch_scalar(runner(params, toks, jax.random.PRNGKey(k)))
         return runner
 
     run_long = make_runner(new_tokens)
@@ -608,7 +622,7 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
         t_in = (toks + rep) % 50257
         t0 = time.perf_counter()
         out = runner(params, t_in, jax.random.PRNGKey(2 + rep))
-        jax.device_get(out[:, -1])
+        _fetch_scalar(out)
         return time.perf_counter() - t0
 
     # Interleaved best-of-4 (the round-4 A/B discipline): decode showed
@@ -676,6 +690,13 @@ def _bench_flash_long_seq(T: int = 8192) -> dict:
     q, k, v, do = (jax.random.normal(x, (B, T, H, D), dtype=jnp.bfloat16)
                    for x in ks)
 
+    # HBM floor for one fwd+bwd: the four (B,T,H,D) bf16 tensors must
+    # each cross HBM at least once; clock floor covers the rest. Catches
+    # elided/deduped executions the way decode's param floor did.
+    tensor_bytes = 4 * q.size * 2
+    call_floor = max(tensor_bytes / _hbm_bandwidth(jax.devices()[0]),
+                     1000 * time.get_clock_info("perf_counter").resolution)
+
     def timed(attn) -> float:
         g = jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(
@@ -683,18 +704,24 @@ def _bench_flash_long_seq(T: int = 8192) -> dict:
                 * do.astype(jnp.float32)),
             argnums=(0, 1, 2)))
 
-        def fetch(out):  # host fetch = the only real barrier under axon
-            return float(jax.device_get(out[0].ravel()[0]))
-
-        fetch(g(q, k, v))  # compile + execute
+        _fetch_scalar(g(q, k, v))  # compile + execute
         best = float("inf")
         for _ in range(3):
-            fetch(g(q, k, v))  # drain pending work before the clock
+            qi = q
+            _fetch_scalar(g(qi, k, v))  # drain before the clock
             t0 = time.perf_counter()
             for _ in range(5):
-                out = g(q, k, v)
-            fetch(out)
+                out = g(qi, k, v)
+                # chain: next query is this call's dq — a data dependency
+                # that also makes every dispatch's inputs distinct, so no
+                # layer of the stack can elide or dedupe repeats
+                qi = out[0].astype(jnp.bfloat16)
+            _fetch_scalar(out)
             best = min(best, (time.perf_counter() - t0) / 5)
+        if best < call_floor:
+            raise MeasurementError(
+                f"flash timing collapsed: {best:.2e}s/call is under the "
+                f"HBM floor {call_floor:.2e}s — executions were elided")
         return best
 
     flash_s = timed(lambda q, k, v: pallas_flash_attention(
@@ -912,6 +939,7 @@ def main() -> None:
         "device_kind": mnist["device_kind"],
         "anchor_samples_per_sec": round(anchor["samples_per_sec"], 1),
         "vs_anchor": round(mnist["vs_anchor"], 4),
+        "pair_ratio_spread": mnist["pair_ratio_spread"],
     }
 
     try:
@@ -956,11 +984,13 @@ def main() -> None:
         except Exception as exc:
             extras[key] = {"error": f"{type(exc).__name__}: {exc}"}
 
-    # round-4: save_attn remat (backward skips the attention recompute;
-    # small has HBM headroom to burn) — interleaved A/B 305 -> 335.5 sps
-    # (+9.6%); saving the GELU output too loses (308), bs16 loses (313)
+    # round-5: the runtime/compiler upgrade flipped round 4's winner —
+    # save_attn (+9.6% then) now LOSES to plain dots_nb by 6.5%
+    # (interleaved sweep: dots_nb 334.9, save_attn 314.4, no-remat 305.2,
+    # full 304.6 sps; tools/ab_sweep.py gpt2). Re-sweep on runtime drift,
+    # don't trust stale winners.
     gpt_extra("gpt2_small", "small", 3,
-              remat_policy="dots_with_no_batch_dims_save_attn")
+              remat_policy="dots_with_no_batch_dims")
 
     try:
         extras["flash_attention_t8192"] = _bench_flash_long_seq()

@@ -159,6 +159,27 @@ def test_ring_scatter_gather_zero_copy():
 
 
 @needs_native
+def test_ring_scatter_gather_noncontiguous():
+    """Non-contiguous arrays (transposes, strided views) take pickle-5's
+    in-band copy path instead of out-of-band buffers — the frame layout
+    must round-trip both kinds in one message."""
+    from ray_lightning_tpu.data.multiproc import (_pack_frames,
+                                                  _unpack_frames)
+    r = ShmRing(f"/tl_t_{os.getpid()}_sgnc", capacity=1 << 22)
+    try:
+        contig = np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+        strided = contig.T            # not C-contiguous
+        every_other = contig[::2]     # strided view
+        r.push_buffers(_pack_frames((contig, strided, every_other)))
+        gc, gs, ge = _unpack_frames(r.pop_view())
+        np.testing.assert_array_equal(gc, contig)
+        np.testing.assert_array_equal(gs, strided)
+        np.testing.assert_array_equal(ge, every_other)
+    finally:
+        r.destroy()
+
+
+@needs_native
 def test_ring_scatter_gather_wraparound():
     """push_buffers honors the same wrap-marker framing as push: messages
     assembled from segments survive many trips around a small ring."""

@@ -117,6 +117,22 @@ SWEEPS = {
                                     "optimizer": "adafactor"}),
         ],
     },
+    "gpt2": {
+        # flagship layout re-check under runtime/compiler drift: the
+        # round-4 winner (save_attn) lost ~10% MFU across round-5
+        # sessions while BERT gained — re-measure the remat space in one
+        # session before attributing it to the environment
+        "build": lambda strategy, batch_size, **o: bench._build_gpt2_step(
+            strategy, batch_size, 512, size="small", **o),
+        "batch_size": 8,
+        "candidates": [
+            ("save_attn", {"remat_policy":
+                           "dots_with_no_batch_dims_save_attn"}),
+            ("dots_nb", {"remat_policy": "dots_with_no_batch_dims"}),
+            ("no_remat", {"remat_policy": "none"}),
+            ("full_remat", {"remat_policy": "full"}),
+        ],
+    },
     "moe": {
         "build": _build_moe_step,
         "batch_size": 16,
