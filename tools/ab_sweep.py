@@ -10,9 +10,11 @@ Round-5 use (VERDICT #6): sweep ``save_attn`` remat and the
 ``make_optimizer`` presets over the ViT and MoE-LM families; results in
 docs/performance.md, winning defaults shipped in the examples.
 
-Usage (real chip):
-    python tools/ab_sweep.py vit
-    python tools/ab_sweep.py moe
+Usage (real chip) — one sweep per model family in ``SWEEPS``:
+    python tools/ab_sweep.py vit      # remat space + adafactor
+    python tools/ab_sweep.py moe      # remat space + optimizer presets
+    python tools/ab_sweep.py gpt2     # flagship remat space (drift check)
+    python tools/ab_sweep.py bert     # save_attn vs dots_nb (drift check)
 
 Prints one JSON line per candidate: {"name", "samples_per_sec", "best_of"}
 plus a final {"winner": ...} line with ratios vs the first (baseline)
@@ -115,6 +117,21 @@ SWEEPS = {
                                      "dots_with_no_batch_dims_save_attn"}),
             ("no_remat_adafactor", {"remat": False, "remat_policy": None,
                                     "optimizer": "adafactor"}),
+        ],
+    },
+    "bert": {
+        # same runtime-drift re-check as gpt2: bert shipped save_attn on
+        # a +1.0-1.2% round-4 margin that the new compiler may have
+        # reversed (it reversed gpt2-small's +9.6%)
+        "build": lambda strategy, batch_size, **o: bench._build_bert_step(
+            strategy, batch_size, 128, **o),
+        "batch_size": 128,
+        "candidates": [
+            # explicit (not the builder default) so a future default flip
+            # can't turn this into a self-comparison — same guard as vit
+            ("save_attn", {"remat_policy":
+                           "dots_with_no_batch_dims_save_attn"}),
+            ("dots_nb", {"remat_policy": "dots_with_no_batch_dims"}),
         ],
     },
     "gpt2": {
