@@ -583,7 +583,14 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     from ray_lightning_tpu.models.generate import generate
 
     total = prompt + new_tokens
-    base = dict(vocab_size=50304, max_seq_len=total, dtype=jnp.bfloat16)
+    # scan_layers=False: under the round-5 runtime the nested loop
+    # (token scan over a layer scan) compiles ~1.9x slower per decode
+    # step than unrolled layers (2.16 vs 1.14 ms/step interleaved A/B;
+    # the device trace shows the whole regression inside while.62, the
+    # inner layer loop). Serving configs should unroll — recompile cost
+    # is paid once per shape.
+    base = dict(vocab_size=50304, max_seq_len=total, dtype=jnp.bfloat16,
+                scan_layers=False)
     model = TransformerLM(gpt2_config("small", **base))
     toks = jnp.asarray(np.random.default_rng(0).integers(
         0, 50257, size=(batch, prompt)), jnp.int32)

@@ -62,14 +62,21 @@ def main():
         import numpy as np
 
         from ray_lightning_tpu.models import TransformerLM, generate
+        from ray_lightning_tpu.models.transformer import unstack_scan_params
 
         # decode needs no remat (single-token steps store no activations)
+        # and unrolled layers (scanned layers nest a loop inside the token
+        # scan — ~2x slower per decode step; see models/generate.py);
+        # unstack_scan_params converts the scanned training weights
         dec_cfg = dataclasses.replace(model.cfg, decode=True, remat=False,
-                                      remat_policy=None)
+                                      remat_policy=None, scan_layers=False,
+                                      scan_unroll=1)
         if trainer.train_state is not None:  # local launch: live arrays
             params = trainer.train_state.params
         else:  # Ray launch: the driver recovered a host state dict
             params = trainer.train_state_dict["params"]
+        if model.cfg.scan_layers:
+            params = unstack_scan_params(params)
         prompt = np.asarray(
             [[1, 2, 3, 4]], dtype=np.int32)
         out = generate(TransformerLM(dec_cfg), params,

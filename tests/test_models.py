@@ -55,19 +55,35 @@ def test_gpt_scan_vs_loop_equivalent(tmp_root):
     scan_model, loop_model = TransformerLM(cfg_scan), TransformerLM(cfg_loop)
     params = scan_model.init(jax.random.PRNGKey(0), toks)["params"]
 
-    # unstack the scanned {"stack": {"layers": {"block": leaves[L, ...]}}}
-    # into the loop layout {"stack": {"block_i": leaves[...]}}
-    loop_params = {k: v for k, v in params.items() if k != "stack"}
-    stacked = params["stack"]["layers"]["block"]
-    loop_params["stack"] = {
-        f"block_{i}": jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
-        for i in range(cfg_loop.n_layers)
-    }
+    from ray_lightning_tpu.models.transformer import (stack_scan_params,
+                                                      unstack_scan_params)
 
+    loop_params = unstack_scan_params(params)
     out_scan = scan_model.apply({"params": params}, toks)
     out_loop = loop_model.apply({"params": loop_params}, toks)
     np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop),
                                rtol=1e-5, atol=1e-5)
+
+    # the inverse restores the scanned tree bit-exactly (resume scanned
+    # training from unrolled-serving weights)
+    restored = stack_scan_params(loop_params)
+    assert (jax.tree_util.tree_structure(restored)
+            == jax.tree_util.tree_structure(params))
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(restored), strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the serving path this converter exists for (docs/performance.md
+    # decode section: unrolled layers decode ~2x faster): scanned
+    # training weights drive an unrolled decode-mode model
+    import dataclasses
+
+    from ray_lightning_tpu.models.generate import generate
+    dec_cfg = dataclasses.replace(cfg_loop, decode=True)
+    out = generate(TransformerLM(dec_cfg), loop_params,
+                   jnp.asarray(toks[:, :12]), max_new_tokens=4,
+                   rng=jax.random.PRNGKey(0), temperature=0.0)
+    assert np.asarray(out).shape == (2, 16)
 
 
 def test_gpt_remat_matches(tmp_root):
