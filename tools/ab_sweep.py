@@ -15,6 +15,7 @@ Usage (real chip) — one sweep per model family in ``SWEEPS``:
     python tools/ab_sweep.py moe      # remat space + optimizer presets
     python tools/ab_sweep.py gpt2     # flagship remat space (drift check)
     python tools/ab_sweep.py bert     # save_attn vs dots_nb (drift check)
+    python tools/ab_sweep.py seq2seq  # encoder-decoder remat space
 
 Prints one JSON line per candidate: {"name", "samples_per_sec", "best_of"}
 plus a final {"winner": ...} line with ratios vs the first (baseline)
@@ -98,7 +99,74 @@ def _build_moe_step(strategy, batch_size: int, seq_len: int = 512,
     return bench._assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
 
 
+def _build_seq2seq_step(strategy, batch_size: int, src_len: int = 256,
+                        tgt_len: int = 256, **cfg_overrides):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_lightning_tpu.core.optim import make_optimizer
+    from ray_lightning_tpu.models.seq2seq import Seq2SeqTransformer
+    from ray_lightning_tpu.models.transformer import TransformerConfig
+
+    opt_name = cfg_overrides.pop("optimizer", "adamw")
+    cfg = TransformerConfig(vocab_size=50304, max_seq_len=max(src_len,
+                                                              tgt_len),
+                            d_model=512, n_heads=8, n_layers=6,
+                            d_ff=2048, causal=True, dtype=jnp.bfloat16,
+                            scan_layers=False, **cfg_overrides)
+    model = Seq2SeqTransformer(cfg)
+    tx = make_optimizer(opt_name, learning_rate=1e-3)
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, 50257, (batch_size, src_len)),
+                      jnp.int32)
+    tgt = jnp.asarray(rng.integers(0, 50257, (batch_size, tgt_len + 1)),
+                      jnp.int32)
+
+    def loss_fn(params, model_state, batch, rng):
+        bsrc, btgt = batch
+        logits = model.apply({"params": params}, bsrc, btgt[:, :-1])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, btgt[:, 1:]).mean()
+        return loss, ({}, model_state)
+
+    # init with 1-example shapes, measure at full batch
+    return bench._assemble_step(
+        strategy, _Seq2SeqInitAdapter(model), tx, loss_fn,
+        (src[:1], tgt[:1]), (src, tgt))
+
+
+class _Seq2SeqInitAdapter:
+    """Adapts the two-input seq2seq model to _assemble_step's
+    single-init-batch contract (init_batch arrives as an (src, tgt)
+    tuple; flax wants them positional). Only ``init`` is consumed —
+    the loss_fn closes over the real model for apply."""
+
+    def __init__(self, model):
+        self._model = model
+
+    def init(self, rng, init_batch):
+        src, tgt = init_batch
+        return self._model.init(rng, src, tgt[:, :-1])
+
+
 SWEEPS = {
+    "seq2seq": {
+        # encoder-decoder family: remat-space drift check (cross-attention
+        # adds a third dot per decoder block — attention flop share is
+        # higher than BERT's at the same T)
+        "build": _build_seq2seq_step,
+        "batch_size": 16,
+        "candidates": [
+            ("no_remat", {}),
+            ("remat_dots_nb", {"remat": True,
+                               "remat_policy":
+                                   "dots_with_no_batch_dims"}),
+            ("remat_save_attn", {"remat": True,
+                                 "remat_policy":
+                                     "dots_with_no_batch_dims_save_attn"}),
+        ],
+    },
     "vit": {
         "build": _build_vit_step,
         # 4 candidates' train states live simultaneously (interleaving
