@@ -12,6 +12,12 @@ the full sequence. Per-chip activation memory scales O(seq_len / sp).
 (DeepSpeed-Ulysses style): two GSPMD resharding collectives per attention
 call instead of sp ring hops; needs n_heads divisible by sp.
 
+Single-chip long context: ``--impl flash`` trains through the pallas
+flash kernels instead of sharding the sequence — at T≥16384 the plain
+XLA attention no longer even compiles on a 16 GiB chip (the f32 score
+tensor alone exceeds HBM; see docs/performance.md), so past that point
+flash (one chip) or ring/ulysses (many chips) are the only paths.
+
 Off-TPU, use the virtual mesh env (see mnist_ddp_example.py).
 """
 import argparse
@@ -23,16 +29,20 @@ from ray_lightning_tpu.models import GPTModule, gpt2_config
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--dp", type=int, default=2,
-                        help="Data-parallel size (batch split).")
+    parser.add_argument("--dp", type=int, default=None,
+                        help="Data-parallel size (batch split); defaults "
+                             "to 2, or 1 in --impl flash (single-chip).")
     parser.add_argument("--sp", type=int, default=4,
                         help="Sequence-parallel size (sequence split).")
     parser.add_argument("--use-tpu", action="store_true", default=False)
     parser.add_argument("--size", default="nano",
                         choices=["nano", "small", "medium", "large", "xl"])
     parser.add_argument("--impl", default="ring",
-                        choices=["ring", "ulysses"],
-                        help="Sequence-parallel attention variant.")
+                        choices=["ring", "ulysses", "flash"],
+                        help="Sequence-parallel attention variant, or "
+                             "'flash' for single-chip long context "
+                             "through the pallas kernels (no sequence "
+                             "sharding; --sp is ignored).")
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--batch-size", type=int, default=4)
     parser.add_argument("--max-epochs", type=int, default=2)
@@ -40,15 +50,25 @@ def main():
     args = parser.parse_args()
 
     seq_len = 256 if args.smoke_test else args.seq_len
+    if args.dp is None:
+        # flash is the single-chip long-context path (the whole sequence
+        # stays on each chip, tiled through VMEM by the kernel), so its
+        # default world is one worker
+        args.dp = 1 if args.impl == "flash" else 2
     cfg = gpt2_config(args.size, max_seq_len=seq_len,
                       attention_impl=args.impl)
     model = GPTModule(config=cfg, batch_size=args.batch_size,
                       seq_len=seq_len,
                       num_samples=4 * args.batch_size if args.smoke_test
                       else 32 * args.batch_size)
+    if args.impl == "flash":
+        from ray_lightning_tpu import RayStrategy
+        strategy = RayStrategy(num_workers=args.dp, use_tpu=args.use_tpu)
+    else:
+        strategy = SequenceParallelStrategy(dp=args.dp, sp=args.sp,
+                                            use_tpu=args.use_tpu)
     trainer = Trainer(
-        strategy=SequenceParallelStrategy(dp=args.dp, sp=args.sp,
-                                          use_tpu=args.use_tpu),
+        strategy=strategy,
         max_epochs=1 if args.smoke_test else args.max_epochs,
         callbacks=[EpochStatsCallback()],
         enable_progress_bar=True,
