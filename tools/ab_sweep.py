@@ -38,67 +38,6 @@ bench = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(bench)
 
 
-def _build_vit_step(strategy, batch_size: int, image_size: int = 224,
-                    patch_size: int = 16, **cfg_overrides):
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from ray_lightning_tpu.core.optim import make_optimizer
-    from ray_lightning_tpu.models.vit import ViTClassifier, vit_config
-
-    opt_name = cfg_overrides.pop("optimizer", "adamw")
-    cfg = vit_config("base", image_size=image_size, patch_size=patch_size,
-                     dtype=jnp.bfloat16, **cfg_overrides)
-    model = ViTClassifier(cfg, num_classes=1000, patch_size=patch_size)
-    tx = make_optimizer(opt_name, learning_rate=1e-3)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal(
-        (batch_size, image_size, image_size, 3)), jnp.float32)
-    y = jnp.asarray(rng.integers(0, 1000, size=(batch_size,)), jnp.int32)
-
-    def loss_fn(params, model_state, batch, rng):
-        bx, by = batch
-        logits = model.apply({"params": params}, bx)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, by).mean()
-        return loss, ({}, model_state)
-
-    return bench._assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
-
-
-def _build_moe_step(strategy, batch_size: int, seq_len: int = 512,
-                    **cfg_overrides):
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from ray_lightning_tpu.core.optim import make_optimizer
-    from ray_lightning_tpu.models.moe import MoeTransformerLM, moe_config
-
-    opt_name = cfg_overrides.pop("optimizer", "adamw")
-    cfg = moe_config("small", vocab_size=50304, max_seq_len=seq_len,
-                     d_model=512, n_heads=8, n_layers=8, d_ff=2048,
-                     n_experts=8, dtype=jnp.bfloat16, **cfg_overrides)
-    model = MoeTransformerLM(cfg)
-    tx = make_optimizer(opt_name, learning_rate=1e-3)
-    rng = np.random.default_rng(0)
-    toks = jnp.asarray(rng.integers(0, 50257,
-                                    size=(batch_size, seq_len + 1)),
-                       jnp.int32)
-    x, y = toks[:, :-1], toks[:, 1:]
-
-    def loss_fn(params, model_state, batch, rng):
-        bx, by = batch
-        logits, aux = model.apply({"params": params}, bx,
-                                  False)  # deterministic=False
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, by).mean() + cfg.aux_loss_weight * aux
-        return loss, ({}, model_state)
-
-    return bench._assemble_step(strategy, model, tx, loss_fn, x[:1], (x, y))
-
-
 def _build_seq2seq_step(strategy, batch_size: int, src_len: int = 256,
                         tgt_len: int = 256, **cfg_overrides):
     import jax.numpy as jnp
@@ -168,7 +107,7 @@ SWEEPS = {
         ],
     },
     "vit": {
-        "build": _build_vit_step,
+        "build": bench._build_vit_step,
         # 4 candidates' train states live simultaneously (interleaving
         # needs them all warm); bs 32 keeps the sum under the 16 GB chip
         "batch_size": 32,
@@ -219,16 +158,19 @@ SWEEPS = {
         ],
     },
     "moe": {
-        "build": _build_moe_step,
+        # bench's moe builder ships the sweep winner (adafactor) as its
+        # default, so candidates name the optimizer EXPLICITLY — an empty
+        # override would self-compare against the winner
+        "build": bench._build_moe_step,
         "batch_size": 16,
         "candidates": [
-            ("no_remat", {}),
-            ("remat_dots_nb", {"remat": True,
-                               "remat_policy":
-                                   "dots_with_no_batch_dims"}),
-            ("remat_save_attn", {"remat": True,
-                                 "remat_policy":
-                                     "dots_with_no_batch_dims_save_attn"}),
+            ("no_remat_adamw", {"optimizer": "adamw"}),
+            ("remat_dots_nb_adamw", {"optimizer": "adamw", "remat": True,
+                                     "remat_policy":
+                                         "dots_with_no_batch_dims"}),
+            ("remat_save_attn_adamw",
+             {"optimizer": "adamw", "remat": True,
+              "remat_policy": "dots_with_no_batch_dims_save_attn"}),
             ("no_remat_adafactor", {"optimizer": "adafactor"}),
         ],
     },
