@@ -619,32 +619,44 @@ def _run_scaling_child(dp: int) -> dict:
 
 
 def _bench_decode(batch: int = 8, prompt: int = 16,
-                  new_tokens: int = 256, short_tokens: int = 64) -> dict:
-    """KV-cache autoregressive decode throughput (GPT-2-small, greedy).
+                  new_tokens: int = 256, short_tokens: int = 64,
+                  prefill_len: int = 512,
+                  prefill_short: int = 128) -> dict:
+    """KV-cache autoregressive decode + prefill throughput (GPT-2-small,
+    greedy).
 
-    The whole prompt-feed + sample loop is ONE jitted ``lax.scan``
-    (models/generate.py) — this measures steady-state tokens/s of cached
-    single-token steps, the serving-side analog of the training headline.
-    Params are served in bf16 (standard inference practice): each decode
-    step reads every weight, so f32 masters would double the per-step
-    HBM traffic that bounds small-batch decode.
+    Generation is TWO jitted programs (models/generate.py): a batched
+    prompt prefill and a tokens-only decode scan with donated
+    cache/tokens buffers — this measures each side separately, the
+    serving-side analog of the training headline. Params are served in
+    bf16 (standard inference practice): each decode step reads every
+    weight, so f32 masters would double the per-step HBM traffic that
+    bounds small-batch decode.
 
-    Round-5 protocol (VERDICT #5): round 4's device trace showed the
-    64-token wall number was ~50% fixed dispatch cost (0.68 ms/step
-    device vs 1.405 ms wall) — an artifact of generation length riding
-    a ~55 ms tunnel round-trip. Two fixes, both reported: (a) the wall
-    measurement now generates 256 tokens, amortizing the dispatch 4×;
-    (b) a differential between 256- and 64-token generations isolates
-    the marginal per-step cost — pure device time, dispatch cancels —
-    reported as ``device_ms_per_token_step`` with the fixed overhead
-    attributed in ``fixed_dispatch_ms``.
+    Round-6 protocol (ADVICE round 5 + the prefill split):
+
+    - ``device_ms_per_token_step`` is the **per-pair median** of the
+      interleaved long/short differentials — ``min(long) - min(short)``
+      took its two minima from different moments, which can understate
+      the marginal step or go negative under jitter and trip
+      MeasurementError on a healthy device (the same pairing break the
+      headline's interleave already fixed).
+    - ``fixed_dispatch_ms`` is clamped at 0: a negative residual means
+      the attribution is not meaningful for this session, not that
+      dispatch has negative cost.
+    - ``prefill_tokens_per_sec`` (wall, P=512) and
+      ``device_prefill_tokens_per_sec`` (per-pair 512/128 differential —
+      dispatch cancels) report the single-pass prompt fill;
+      ``prefill_speedup_vs_sequential`` compares the differential
+      per-position prefill cost against ``device_ms_per_token_step``,
+      the cost the same prompt would pay fed token-by-token.
     """
     import jax
     import jax.numpy as jnp
 
     from ray_lightning_tpu.models.gpt import gpt2_config
     from ray_lightning_tpu.models.transformer import TransformerLM
-    from ray_lightning_tpu.models.generate import generate
+    from ray_lightning_tpu.models.generate import generate, prefill
 
     total = prompt + new_tokens
     # scan_layers=False: under the round-5 runtime the nested loop
@@ -674,10 +686,14 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     toks = jax.device_put(toks)
 
     def make_runner(n: int):
+        # generate() is itself two jitted programs (prefill + donated
+        # decode scan); wrapping it in ANOTHER jit would inline both into
+        # one program and silently drop the buffer donation, so the
+        # runner stays plain python — the long/short differential
+        # cancels the extra dispatch the same way it cancels the first.
         def run(params, toks, rng):
             return generate(dec, params, toks, max_new_tokens=n,
                             rng=rng, temperature=0.0)
-        runner = jax.jit(run)
         # warm up with a FETCH, twice: under the axon tunnel
         # block_until_ready can return before remote execution finishes
         # (observed: 271 decode steps "completing" in 2.7e-5 s — caught
@@ -685,8 +701,8 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
         # data is a real barrier; the second call drains residual
         # first-dispatch cost (~4 s observed) out of the timed reps
         for k in (1, 99):
-            _fetch_scalar(runner(params, toks, jax.random.PRNGKey(k)))
-        return runner
+            _fetch_scalar(run(params, toks, jax.random.PRNGKey(k)))
+        return run
 
     run_long = make_runner(new_tokens)
     run_short = make_runner(short_tokens)
@@ -700,21 +716,24 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
         _fetch_scalar(out)
         return time.perf_counter() - t0
 
-    # Interleaved best-of-4 (the round-4 A/B discipline): decode showed
-    # ±16% session spread across rounds; alternating long/short gives
-    # both lengths the same noise field so the differential stays clean.
-    best_long = best_short = float("inf")
+    # Interleaved pairs (the round-4 A/B discipline): decode showed ±16%
+    # session spread across rounds; alternating long/short gives both
+    # lengths the same noise field. The differential statistic is
+    # per-PAIR (adjacent measurements share the same instantaneous
+    # session conditions), then the median across pairs — mirroring
+    # bench_headline_interleaved's ratio statistic.
+    longs, pair_diffs = [], []
     for i in range(4):
-        best_long = min(best_long, timed(run_long, i))
-        best_short = min(best_short, timed(run_short, 10 + i))
-    # generate()'s scan runs total-1 single-token forward steps (prompt
-    # feed + sampling share the same cached step); account each metric
-    # against what was actually executed — steps for the steady-state
-    # rate, sampled tokens for the end-to-end generation rate
-    n_steps = total - 1
-    n_steps_short = prompt + short_tokens - 1
-    diff = best_long - best_short
-    diff_steps = n_steps - n_steps_short
+        t_long = timed(run_long, i)
+        t_short = timed(run_short, 10 + i)
+        longs.append(t_long)
+        pair_diffs.append(t_long - t_short)
+    best_long = min(longs)
+    diff = float(np.median(pair_diffs))
+    # the marginal cost of a generated token is one cached decode step;
+    # the prefill program and its dispatch are identical on both sides
+    # of the differential and cancel
+    diff_steps = new_tokens - short_tokens
     # Honesty guard (same contract as _measure_rate): a collapsed timing
     # must raise, never print. The floor IS the physical bound: every
     # decode step reads at least all params, so the run cannot finish
@@ -724,29 +743,95 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     hbm_bw = _hbm_bandwidth(jax.devices()[0])
     step_floor = (2 * n_params) / (1.5 * hbm_bw)
     resolution = 1000 * time.get_clock_info("perf_counter").resolution
-    if best_long < max(n_steps * step_floor, resolution):
+    if best_long < max(new_tokens * step_floor, resolution):
         raise MeasurementError(
-            f"decode timing collapsed: {best_long:.2e}s for {n_steps} "
-            f"scan steps is below the param-bandwidth floor — device "
-            "elided work or async dispatch leaked")
+            f"decode timing collapsed: {best_long:.2e}s for {new_tokens} "
+            f"generated tokens is below the param-bandwidth floor — "
+            "device elided work or async dispatch leaked")
     if diff < max(diff_steps * step_floor, resolution):
         raise MeasurementError(
-            f"decode differential collapsed: {diff:.2e}s for "
+            f"decode differential collapsed: {diff:.2e}s median for "
             f"{diff_steps} marginal steps is below the param-bandwidth "
-            "floor — the two lengths did not both execute")
+            "floor — the two lengths did not both execute "
+            f"(pair_diffs={[round(d, 4) for d in pair_diffs]})")
     device_ms = 1e3 * diff / diff_steps
+
+    # ------- prefill: one batched (B, P) prompt-fill program ---------- #
+    # Own model instance: prefill needs max_seq_len >= P=512 and the
+    # decode model above is sized to its generation. Interleaved 512/128
+    # per-pair differential, same discipline as decode — the marginal
+    # 384 positions are pure prefill compute, dispatch cancels.
+    pf_base = dict(vocab_size=50304, max_seq_len=prefill_len,
+                   dtype=jnp.bfloat16, scan_layers=False)
+    pf_dec = TransformerLM(gpt2_config("small", decode=True,
+                                       param_dtype=jnp.bfloat16,
+                                       **pf_base))
+    pf_params = jax.device_put(jax.jit(
+        lambda r: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16),
+            TransformerLM(gpt2_config("small", **pf_base)).init(
+                r, toks)["params"]))(jax.random.PRNGKey(0)))
+    pf_toks = jax.device_put(jnp.asarray(
+        np.random.default_rng(1).integers(
+            0, 50257, size=(batch, prefill_len)), jnp.int32))
+
+    def pf_timed(P: int, rep: int) -> float:
+        t_in = (pf_toks[:, :P] + rep) % 50257
+        t0 = time.perf_counter()
+        _cache, last = prefill(pf_dec, pf_params, t_in)
+        _fetch_scalar(last)
+        return time.perf_counter() - t0
+
+    for P in (prefill_len, prefill_short):  # compile + drain, fetched
+        for rep in (90, 91):
+            pf_timed(P, rep)
+    pf_longs, pf_diffs = [], []
+    for i in range(4):
+        t_long = pf_timed(prefill_len, i)
+        t_short = pf_timed(prefill_short, 20 + i)
+        pf_longs.append(t_long)
+        pf_diffs.append(t_long - t_short)
+    pf_best = min(pf_longs)
+    pf_diff = float(np.median(pf_diffs))
+    # prefill reads params once per CALL (not per token), so the only
+    # floors with teeth are one param pass and the clock
+    if pf_best < max(step_floor, resolution):
+        raise MeasurementError(
+            f"prefill timing collapsed: {pf_best:.2e}s for a "
+            f"(B={batch}, P={prefill_len}) forward is below one param "
+            "pass over HBM — execution was elided")
+    if pf_diff <= resolution:
+        raise MeasurementError(
+            f"prefill differential collapsed: {pf_diff:.2e}s median for "
+            f"{prefill_len - prefill_short} marginal positions "
+            f"(pair_diffs={[round(d, 4) for d in pf_diffs]})")
+    # per-position marginal prefill cost vs the per-token decode step the
+    # same positions would cost fed sequentially (both cover `batch` rows)
+    pf_pos_ms = 1e3 * pf_diff / (prefill_len - prefill_short)
+
     return {
         "model": "gpt2_small (bf16 serving params)", "batch": batch,
         "prompt": prompt, "new_tokens": new_tokens,
-        "token_steps_per_sec": round(batch * n_steps / best_long, 0),
         "generated_tokens_per_sec": round(
             batch * new_tokens / best_long, 0),
-        "ms_per_token_step": round(1e3 * best_long / n_steps, 3),
+        # decode-only wall cost per generated token (prefill + both
+        # program dispatches amortized in)
+        "ms_per_token_step": round(1e3 * best_long / new_tokens, 3),
         "device_ms_per_token_step": round(device_ms, 3),
         "device_token_steps_per_sec": round(
             batch * 1e3 / device_ms, 0),
+        # residual after attributing every generated token its marginal
+        # device step; clamped — negative residuals mean the attribution
+        # is not meaningful under this session's jitter, not that
+        # dispatch has negative cost
         "fixed_dispatch_ms": round(
-            1e3 * best_long - device_ms * n_steps, 1),
+            max(0.0, 1e3 * best_long - device_ms * new_tokens), 1),
+        "prefill_len": prefill_len,
+        "prefill_tokens_per_sec": round(
+            batch * prefill_len / pf_best, 0),
+        "device_prefill_tokens_per_sec": round(
+            batch * (prefill_len - prefill_short) / pf_diff, 0),
+        "prefill_speedup_vs_sequential": round(device_ms / pf_pos_ms, 1),
     }
 
 
@@ -1198,6 +1283,11 @@ def main() -> None:
                 with open(REFERENCE_FILE, "w") as f:
                     json.dump(ref, f, indent=2)
             ref_extras = ref.get("extras", {})
+            # re-anchor the (possibly fresh) extras dict INTO the
+            # reference before any dump: a loaded reference that lacks an
+            # 'extras' key would otherwise take the first recordings into
+            # a detached dict and silently drop them on write
+            ref["extras"] = ref_extras
             ref_dirty = False
             for key, field in tracked_extras.items():
                 cur = extras.get(key, {}).get(field)
@@ -1205,10 +1295,13 @@ def main() -> None:
                 if cur is not None and ref_val:
                     extras[key]["vs_reference"] = round(
                         float(cur) / float(ref_val), 3)
-                elif cur is not None:
+                elif cur is not None and 0.93 <= vs_baseline <= 1.10:
                     # protocol gained a field (or a whole workload) the
                     # anchor predates: record the first valid measurement
-                    # so later runs compare against it
+                    # so later runs compare against it — but only from a
+                    # session whose headline sits inside the known jitter
+                    # band, so a degraded (or miraculous) session never
+                    # becomes a new metric's permanent baseline
                     ref_extras.setdefault(key, {})[field] = cur
                     ref_extras[key][f"{field}_recorded"] = (
                         "auto-recorded on first valid measurement "

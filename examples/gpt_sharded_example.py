@@ -67,7 +67,9 @@ def main():
         # decode needs no remat (single-token steps store no activations)
         # and unrolled layers (scanned layers nest a loop inside the token
         # scan — ~2x slower per decode step; see models/generate.py);
-        # unstack_scan_params converts the scanned training weights
+        # unstack_scan_params converts the scanned training weights.
+        # generate() runs the prefill/decode split: the prompt fills the
+        # KV cache in one compiled pass, then a tokens-only scan samples.
         dec_cfg = dataclasses.replace(model.cfg, decode=True, remat=False,
                                       remat_policy=None, scan_layers=False,
                                       scan_unroll=1)

@@ -176,11 +176,24 @@ class ShmRing:
         """
         import numpy as np
 
+        def as_u8(b):
+            # np.frombuffer works for read-only and writable buffers alike
+            # and exposes a stable data pointer — but it requires a
+            # C-contiguous segment and raises a confusing low-level error
+            # for strided views (e.g. a transposed array's memoryview).
+            # Normalize those through an explicit contiguous copy; the
+            # consumer reassembles from raw bytes, so the copy is
+            # semantics-preserving (one extra memcpy on a cold path).
+            try:
+                return np.frombuffer(b, dtype=np.uint8)
+            except (ValueError, BufferError):
+                contig = np.ascontiguousarray(b)
+                return contig.reshape(-1).view(np.uint8)
+
         n = len(buffers)
-        # np.frombuffer works for read-only and writable buffers alike and
-        # exposes a stable data pointer; the `views` list keeps every
-        # segment alive across the native call.
-        views = [np.frombuffer(b, dtype=np.uint8) for b in buffers]
+        # the `views` list keeps every segment alive across the native
+        # call.
+        views = [as_u8(b) for b in buffers]
         ptrs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
         lens = (ctypes.c_uint64 * n)(*[v.nbytes for v in views])
         rc = self._lib.tlshm_push_v(self._h, ptrs, lens, n, timeout)

@@ -18,7 +18,8 @@ from ray_lightning_tpu.models.vit import (ViTClassifier, ViTModule,
                                           vit_config)
 from ray_lightning_tpu.models.seq2seq import (Seq2SeqModule,
                                               Seq2SeqTransformer)
-from ray_lightning_tpu.models.generate import generate, sample_logits
+from ray_lightning_tpu.models.generate import (generate, generate_full_scan,
+                                               prefill, sample_logits)
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
@@ -28,6 +29,7 @@ __all__ = [
     "resnet10", "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
     "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config",
-    "generate", "sample_logits", "tensor_parallel_rule",
+    "generate", "generate_full_scan", "prefill", "sample_logits",
+    "tensor_parallel_rule",
     "Seq2SeqModule", "Seq2SeqTransformer"
 ]

@@ -1,11 +1,26 @@
-"""Autoregressive generation with a KV cache, compiled as one program.
+"""Autoregressive generation: batched single-pass prefill + tokens-only scan.
 
-TPU-native decode: the whole prompt-feed + sample loop is a single
-``lax.scan`` under ``jit`` — no per-token Python dispatch, static shapes
-throughout (prompt and generation lengths are baked into the compiled
-program; re-generating with the same shapes reuses the cache). Each step
-attends over the KV cache (O(T) per token instead of O(T²) re-encoding),
-the pattern every production LM server uses.
+TPU-native serving decomposition — the prefill/decode split every
+production LM server (vLLM, TGI, JetStream) made canonical:
+
+1. **Prefill** (:func:`prefill`): the whole ``(B, P)`` prompt runs
+   through the decode-mode model in ONE compiled forward — a length-P
+   block lands in the KV cache via ``dynamic_update_slice`` under an
+   intra-prompt causal mask, and the last-position logits come back.
+   Prompt cost is one matmul-rich pass instead of P sequential
+   ~per-token dispatches (measured ≥5× at P=512; see
+   ``docs/performance.md`` decode section and ``bench.py``'s
+   ``prefill_tokens_per_sec``).
+2. **Decode** (tokens-only ``lax.scan``): exactly ``max_new_tokens - 1``
+   cached single-token steps (the first new token is sampled from the
+   prefill logits), jitted with ``donate_argnums`` on the cache and
+   tokens buffers so the carry updates alias in place instead of
+   copying.
+
+Static shapes throughout (prompt and generation lengths are baked into
+the two compiled programs; same shapes reuse the cache). Each decode
+step attends over the KV cache (O(T) per token instead of O(T²)
+re-encoding).
 
 Usage::
 
@@ -16,6 +31,28 @@ Usage::
 
 ``params`` come from the *training* config (same architecture, decode
 off); the decode flag only switches the attention to its cached path.
+
+Batched variable-length prompts: left-align each row, pad the tail to a
+common P, and pass ``prompt_lengths`` (B,). Prefill needs no extra
+masking for the pad tail — the intra-prompt causal mask already hides
+later keys from every valid query, and the pad positions' K/V are
+overwritten by the per-row decode scan before any step can attend them
+(each row's step *s* writes cache slot ``lengths[row] + s`` and masks
+keys beyond it). Each row emits exactly ``max_new_tokens`` tokens at
+positions ``lengths[row]..lengths[row]+max_new_tokens-1``; a short
+row's positions beyond its window keep whatever pad values the caller
+supplied there (the appended region past P is zero-initialized, the
+prompt pad is passed through untouched) — slice each row by its own
+window, don't sentinel on the tail. ``eos_id`` stops a row once
+sampled: every
+later position in its window repeats the eos token (the scan still runs
+full length — static shapes).
+
+The legacy single-program path (prompt teacher-forced through the same
+one-token-at-a-time scan used for sampling) is kept as
+:func:`generate_full_scan` — it is the reference the prefill+scan
+equivalence tests compare against, and ``generate(...,
+use_prefill=False)`` selects it.
 
 Serving tip (measured, ``docs/performance.md`` decode section): build
 the decode config with ``scan_layers=False`` and convert scanned
@@ -54,38 +91,233 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit,
-         static_argnames=("model", "max_new_tokens", "temperature",
-                          "top_k", "eos_id"))
-def generate(model, params, prompt_tokens: jax.Array,
-             max_new_tokens: int, rng: jax.Array,
-             temperature: float = 1.0,
-             top_k: Optional[int] = None,
-             prompt_lengths: Optional[jax.Array] = None,
-             eos_id: Optional[int] = None) -> jax.Array:
-    """Generate ``max_new_tokens`` past ``prompt_tokens`` (B, P).
-
-    Returns (B, P + max_new_tokens) int32. ``model.cfg.decode`` must be
-    True and ``cfg.max_seq_len >= P + max_new_tokens``.
-
-    Batched variable-length prompts: left-align each row, pad the tail to
-    a common P (pad values are never read), and pass ``prompt_lengths``
-    (B,) — row *i* starts sampling at position ``prompt_lengths[i]``, so
-    no padding ever enters the cache and no attention mask is needed.
-    ``eos_id`` stops a row once sampled: every later position repeats the
-    eos token (the scan still runs full length — static shapes).
-    """
+def _check_decode_model(model, P: int, max_new_tokens: int = 0) -> None:
     cfg = model.cfg
     if not cfg.decode:
         raise ValueError(
             "generate() needs a decode-mode model: rebuild the config "
             "with decode=True (params are compatible)")
-    B, P = prompt_tokens.shape
-    total = P + max_new_tokens
-    if total > cfg.max_seq_len:
+    if P + max_new_tokens > cfg.max_seq_len:
         raise ValueError(
             f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_seq_len ({cfg.max_seq_len})")
+
+
+def _logits_only(outputs):
+    # MoE LMs return (logits, aux_loss); serving only needs the logits
+    return outputs[0] if isinstance(outputs, tuple) else outputs
+
+
+def _row_update(rows: jax.Array, vals: jax.Array,
+                starts: jax.Array) -> jax.Array:
+    """Per-row ``dynamic_update_slice`` along axis 1: write ``vals``
+    (B, 1) into ``rows`` (B, T) at each row's own ``starts`` (B,)."""
+    return jax.vmap(
+        lambda row, val, i: jax.lax.dynamic_update_slice(row, val, (i,)))(
+            rows, vals, starts)
+
+
+def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
+    B, P = prompt_tokens.shape
+    prompt_tokens = prompt_tokens.astype(jnp.int32)
+    cache = model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((B, 1), jnp.int32),
+                       positions=jnp.zeros((B, 1), jnp.int32))["cache"]
+    positions = jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
+    outputs, updated = model.apply(
+        {"params": params, "cache": cache}, prompt_tokens,
+        positions=positions, deterministic=True, mutable=["cache"])
+    logits = _logits_only(outputs)
+    if prompt_lengths is None:
+        last = logits[:, -1]
+    else:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return updated["cache"], last
+
+
+@partial(jax.jit, static_argnames=("model",))
+def prefill(model, params, prompt_tokens: jax.Array,
+            prompt_lengths: Optional[jax.Array] = None):
+    """Single-pass prompt fill: run the full ``(B, P)`` prompt through
+    the decode-mode model in one forward, writing cache slots ``0..P-1``.
+
+    Returns ``(cache, last_logits)`` where ``last_logits`` (B, V) are the
+    logits at each row's final prompt position (``prompt_lengths[i]-1``
+    when lengths are given, else ``P-1``) — sample the first generated
+    token from them, then continue with per-token cached decode steps.
+    Causality makes them exact for left-aligned ragged rows: position
+    ``L-1`` never attends past itself, so the pad tail cannot leak in.
+
+    Ragged continuation contract: after a ragged prefill the cache slots
+    ``lengths[i]..P-1`` of short rows hold pad-tail K/V, so the decode
+    steps MUST use per-row ``kv_positions`` (each row's step *s* writes
+    slot ``lengths[i] + s`` and masks keys beyond it, overwriting the
+    garbage before it can be attended) — exactly what :func:`generate`
+    does. A plain shared-index step after a ragged prefill would write at
+    slot P and let short rows attend their pad-tail slots: silently
+    wrong. Uniform prompts (``prompt_lengths=None``) may continue with
+    plain shared-index steps.
+    """
+    _check_decode_model(model, prompt_tokens.shape[1])
+    return _prefill_impl(model, params, prompt_tokens, prompt_lengths)
+
+
+@partial(jax.jit,
+         static_argnames=("model", "max_new_tokens", "temperature",
+                          "top_k", "eos_id", "ragged"))
+def _prefill_start(model, params, prompt_tokens, lengths, rng, *,
+                   max_new_tokens, temperature, top_k, eos_id, ragged):
+    """Program 1 of the split: prefill + first-token sample + output
+    buffer assembly, fused so generate() costs exactly two dispatches."""
+    B, P = prompt_tokens.shape
+    cache, last = _prefill_impl(model, params, prompt_tokens,
+                                lengths if ragged else None)
+    rng, sub = jax.random.split(rng)
+    first = sample_logits(last, sub, temperature, top_k)
+    done = (first == eos_id) if eos_id is not None \
+        else jnp.zeros((B,), jnp.bool_)
+    tokens = jnp.concatenate(
+        [prompt_tokens.astype(jnp.int32),
+         jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
+    if ragged:
+        tokens = _row_update(tokens, first[:, None], lengths)
+    else:
+        tokens = jax.lax.dynamic_update_slice_in_dim(
+            tokens, first[:, None], P, axis=1)
+    return cache, tokens, rng, done
+
+
+def _decode_scan(model, cache, tokens, params, lengths, rng, done0, *,
+                 steps, temperature, top_k, eos_id, ragged):
+    """Program 2 of the split: ``steps`` cached single-token decode steps
+    starting from the prefill cache. The cache and tokens buffers are
+    donated — the scan carry updates them in place, no per-call copies.
+    """
+    B, total = tokens.shape
+
+    def step(carry, s):
+        cache, tokens, rng, done = carry
+        if ragged:
+            # rows sit at different lengths: read/write at per-row
+            # positions; the cache writes are per-row too (kv_positions)
+            pos = (lengths + s)[:, None]
+            cur = jnp.take_along_axis(tokens, pos, axis=1)
+            outputs, updated = model.apply(
+                {"params": params, "cache": cache}, cur, positions=pos,
+                kv_positions=pos, deterministic=True, mutable=["cache"])
+        else:
+            t = total - steps - 1 + s
+            cur = jax.lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)
+            pos = jnp.full((B, 1), t, jnp.int32)
+            outputs, updated = model.apply(
+                {"params": params, "cache": cache}, cur, positions=pos,
+                deterministic=True, mutable=["cache"])
+        logits = _logits_only(outputs)
+        rng, sub = jax.random.split(rng)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+        if eos_id is not None:
+            # every scanned step samples strictly past the prompt, so
+            # (unlike the teacher-forced legacy scan) latching needs no
+            # "generating" gate
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        if ragged:
+            tokens = _row_update(tokens, nxt[:, None], lengths + s + 1)
+        else:
+            tokens = jax.lax.dynamic_update_slice_in_dim(
+                tokens, nxt[:, None], total - steps + s, axis=1)
+        return (updated["cache"], tokens, rng, done), None
+
+    (_, tokens, _, _), _ = jax.lax.scan(
+        step, (cache, tokens, rng, done0), jnp.arange(steps))
+    return tokens
+
+
+_SCAN_STATICS = ("model", "steps", "temperature", "top_k", "eos_id",
+                 "ragged")
+_decode_scan_donated = partial(
+    jax.jit, static_argnames=_SCAN_STATICS,
+    donate_argnums=(1, 2))(_decode_scan)
+_decode_scan_plain = partial(
+    jax.jit, static_argnames=_SCAN_STATICS)(_decode_scan)
+
+
+def _decode_scan_jit():
+    """Donate the cache/tokens carry wherever the backend honors it; the
+    CPU backend ignores donation with a warning per buffer, so tests stay
+    quiet on the plain variant (the programs are otherwise identical)."""
+    return (_decode_scan_plain if jax.default_backend() == "cpu"
+            else _decode_scan_donated)
+
+
+def generate(model, params, prompt_tokens: jax.Array,
+             max_new_tokens: int, rng: jax.Array,
+             temperature: float = 1.0,
+             top_k: Optional[int] = None,
+             prompt_lengths: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None,
+             use_prefill: bool = True) -> jax.Array:
+    """Generate ``max_new_tokens`` past ``prompt_tokens`` (B, P).
+
+    Returns (B, P + max_new_tokens) int32. ``model.cfg.decode`` must be
+    True and ``cfg.max_seq_len >= P + max_new_tokens``.
+
+    Two compiled programs: a batched prompt prefill (one forward for all
+    P positions) and a tokens-only decode scan of ``max_new_tokens - 1``
+    steps with donated cache/tokens buffers — see the module docstring.
+    ``use_prefill=False`` selects the legacy single-program path
+    (:func:`generate_full_scan`); greedy outputs are token-identical
+    either way (pinned by tests/test_prefill.py). Sampling
+    (``temperature > 0``) is equivalent in distribution but consumes the
+    rng stream differently from the legacy path (which burned one split
+    per teacher-forced prompt position).
+    """
+    if not use_prefill:
+        return generate_full_scan(model, params, prompt_tokens,
+                                  max_new_tokens, rng, temperature, top_k,
+                                  prompt_lengths, eos_id)
+    B, P = prompt_tokens.shape
+    _check_decode_model(model, P, max_new_tokens)
+    ragged = prompt_lengths is not None
+    prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
+    lengths = (jnp.asarray(prompt_lengths, jnp.int32) if ragged
+               else jnp.full((B,), P, jnp.int32))
+    cache, tokens, rng, done = _prefill_start(
+        model, params, prompt_tokens, lengths, rng,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, eos_id=eos_id, ragged=ragged)
+    if max_new_tokens == 1:
+        return tokens
+    return _decode_scan_jit()(
+        model, cache, tokens, params, lengths, rng, done,
+        steps=max_new_tokens - 1, temperature=temperature,
+        top_k=top_k, eos_id=eos_id, ragged=ragged)
+
+
+@partial(jax.jit,
+         static_argnames=("model", "max_new_tokens", "temperature",
+                          "top_k", "eos_id"))
+def generate_full_scan(model, params, prompt_tokens: jax.Array,
+                       max_new_tokens: int, rng: jax.Array,
+                       temperature: float = 1.0,
+                       top_k: Optional[int] = None,
+                       prompt_lengths: Optional[jax.Array] = None,
+                       eos_id: Optional[int] = None) -> jax.Array:
+    """Legacy one-program path: the prompt is teacher-forced through the
+    same one-token-at-a-time scan used for sampling (P sequential steps
+    before the first new token). Kept as the equivalence reference for
+    the prefill+scan split; prefer :func:`generate`.
+
+    Variable-length note: this path fills every row to the common
+    ``P + max_new_tokens`` length (short rows keep generating past their
+    ``prompt_lengths[i] + max_new_tokens`` window), where the split path
+    stops each row after exactly ``max_new_tokens`` tokens.
+    """
+    B, P = prompt_tokens.shape
+    _check_decode_model(model, P, max_new_tokens)
+    total = P + max_new_tokens
     lengths = (jnp.full((B,), P, jnp.int32) if prompt_lengths is None
                else jnp.asarray(prompt_lengths, jnp.int32))
 
@@ -102,11 +334,10 @@ def generate(model, params, prompt_tokens: jax.Array,
         cache, tokens, rng, done = carry
         cur = jax.lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)
         pos = jnp.full((B, 1), t, jnp.int32)
-        logits, updated = model.apply(
+        outputs, updated = model.apply(
             {"params": params, "cache": cache}, cur, positions=pos,
             deterministic=True, mutable=["cache"])
-        if isinstance(logits, tuple):  # MoE LMs return (logits, aux_loss)
-            logits = logits[0]
+        logits = _logits_only(outputs)
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
         if eos_id is not None:

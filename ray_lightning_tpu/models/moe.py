@@ -139,11 +139,12 @@ class MoeTransformerBlock(nn.Module):
     cfg: MoeConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
         x = x + MultiHeadAttention(cfg, name="attn")(
-            h, mask=mask, deterministic=deterministic)
+            h, mask=mask, deterministic=deterministic,
+            kv_positions=kv_positions)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
         moe_out, aux = MoeMlp(cfg, name="moe")(h)
         return x + moe_out, aux
@@ -156,7 +157,8 @@ class MoeTransformerLM(nn.Module):
     cfg: MoeConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True, positions=None):
+    def __call__(self, tokens, deterministic: bool = True, positions=None,
+                 kv_positions=None):
         cfg = self.cfg
         B, T = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -173,7 +175,7 @@ class MoeTransformerLM(nn.Module):
                                 deterministic_argnum=3)
         for i in range(cfg.n_layers):
             x, aux = block_cls(cfg, name=f"block_{i}")(
-                x, None, deterministic)
+                x, None, deterministic, kv_positions)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = wte.attend(x)

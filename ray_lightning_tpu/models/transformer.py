@@ -160,7 +160,7 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
         cfg = self.cfg
         B, T, _ = x.shape
         qkv = nn.DenseGeneral(
@@ -173,7 +173,7 @@ class MultiHeadAttention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         causal = cfg.causal
         if cfg.decode:
-            k, v, cache_mask = self._decode_cache(k, v)
+            k, v, cache_mask = self._decode_cache(k, v, kv_positions)
             if cache_mask is not None:
                 # combine with any caller mask (e.g. left-pad masking for
                 # batched prompts) — both are additive 0/-inf biases
@@ -202,11 +202,27 @@ class MultiHeadAttention(nn.Module):
             features=cfg.d_model, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="out")(out)
 
-    def _decode_cache(self, k, v):
-        """One-token KV-cache update (flax decode pattern): the "cache"
-        collection holds keys/values for all ``max_seq_len`` positions;
-        each call writes the new token at ``cache_index`` and attends over
-        positions ``<= cache_index`` via an additive mask."""
+    def _decode_cache(self, k, v, kv_positions=None):
+        """KV-cache update (flax decode pattern): the "cache" collection
+        holds keys/values for all ``max_seq_len`` positions. Two write
+        modes:
+
+        - ``kv_positions=None`` — write a block of ``T >= 1`` new
+          positions at the shared ``cache_index`` (T=1 is the classic
+          per-token decode step; T>1 is the prefill path writing the whole
+          prompt in one ``dynamic_update_slice``). The returned additive
+          mask is intra-block causal over the cache buffer: query ``q`` of
+          the block attends positions ``<= cache_index + q``.
+        - ``kv_positions`` (B, 1) — per-row single-token write at each
+          row's own absolute position (ragged decode: rows sit at
+          different sequence lengths). Lowered as a vmapped
+          ``dynamic_update_slice`` (a batched scatter); the mask is
+          per-row ``key <= kv_positions[row]``.
+
+        The scalar ``cache_index`` advances by ``T`` either way; in the
+        per-row mode it is bookkeeping only (positions come from the
+        caller).
+        """
         cfg = self.cfg
         B, T, H, D = k.shape
         is_init = not self.has_variable("cache", "cached_key")
@@ -218,18 +234,35 @@ class MultiHeadAttention(nn.Module):
                            lambda: jnp.zeros((), jnp.int32))
         if is_init:  # shape-building init pass: no cache semantics yet
             return k, v, None
-        if T != 1:
-            raise ValueError(
-                f"decode mode consumes one token per call, got T={T}; "
-                "feed the prompt token-by-token (models/generate.py)")
+        key_pos = jax.lax.broadcasted_iota(jnp.int32,
+                                           (1, 1, 1, cfg.max_seq_len), 3)
+        big_neg = jnp.finfo(jnp.float32).min
+        if kv_positions is not None:
+            if T != 1:
+                raise ValueError(
+                    f"per-row kv_positions writes are single-token, got "
+                    f"T={T}; block (prefill) writes use the shared index "
+                    "(kv_positions=None)")
+            pos = kv_positions[:, 0].astype(jnp.int32)          # (B,)
+            row_write = jax.vmap(
+                lambda c, u, i: jax.lax.dynamic_update_slice(c, u,
+                                                             (i, 0, 0)))
+            ck.value = row_write(ck.value, k, pos)
+            cv.value = row_write(cv.value, v, pos)
+            ci.value = ci.value + T
+            mask = jnp.where(key_pos <= pos[:, None, None, None], 0.0,
+                             big_neg)                           # (B,1,1,S)
+            return ck.value, cv.value, mask
         idx = ci.value
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
         ci.value = idx + T
-        key_pos = jax.lax.broadcasted_iota(jnp.int32,
-                                           (1, 1, 1, cfg.max_seq_len), 3)
-        big_neg = jnp.finfo(jnp.float32).min
-        mask = jnp.where(key_pos <= idx, 0.0, big_neg)
+        if T == 1:
+            mask = jnp.where(key_pos <= idx, 0.0, big_neg)      # (1,1,1,S)
+        else:
+            q_off = jax.lax.broadcasted_iota(jnp.int32, (1, 1, T, 1), 2)
+            mask = jnp.where(key_pos <= idx + q_off, 0.0,
+                             big_neg)                           # (1,1,T,S)
         return ck.value, cv.value, mask
 
 
@@ -256,11 +289,12 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
         x = x + MultiHeadAttention(cfg, name="attn")(
-            h, mask=mask, deterministic=deterministic)
+            h, mask=mask, deterministic=deterministic,
+            kv_positions=kv_positions)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
         x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic)
         return x
@@ -277,10 +311,11 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, mask = carry
+        x, mask, kv_positions = carry
         x = TransformerBlock(self.cfg, name="block")(
-            x, mask=mask, deterministic=self.deterministic)
-        return (x, mask), None
+            x, mask=mask, deterministic=self.deterministic,
+            kv_positions=kv_positions)
+        return (x, mask, kv_positions), None
 
 
 def check_seq_len(cfg: TransformerConfig, length: int,
@@ -344,7 +379,7 @@ class TransformerStack(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
         cfg = self.cfg
         if cfg.scan_layers:
             block_cls = _ScanBlock
@@ -359,13 +394,14 @@ class TransformerStack(nn.Module):
                 length=cfg.n_layers,
                 unroll=min(cfg.scan_unroll, cfg.n_layers),
                 metadata_params={nn.PARTITION_NAME: "layers"})
-            (x, _), _ = stack(cfg, deterministic, name="layers")(
-                (x, mask), None)
+            (x, _, _), _ = stack(cfg, deterministic, name="layers")(
+                (x, mask, kv_positions), None)
             return x
         block_cls = maybe_remat(TransformerBlock, cfg,
                                 deterministic_argnum=3)
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"block_{i}")(x, mask, deterministic)
+            x = block_cls(cfg, name=f"block_{i}")(x, mask, deterministic,
+                                                  kv_positions)
         return x
 
 
@@ -413,6 +449,15 @@ def stack_scan_params(params):
                     key=lambda k: int(k[6:]))
     out = {}
     if blocks and [int(k[6:]) for k in blocks] == list(range(len(blocks))):
+        if "layers" in params:
+            # a literal 'layers' sibling would collide with the stacked
+            # output key and one of the two subtrees would be silently
+            # dropped — refuse loudly instead
+            raise ValueError(
+                "stack_scan_params: this level has both block_i siblings "
+                f"({blocks[0]}..{blocks[-1]}) and a literal 'layers' key; "
+                "stacking would overwrite one of them — rename the "
+                "'layers' subtree before restacking")
         stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs, axis=0),
             *[params[k] for k in blocks])
@@ -432,6 +477,11 @@ class TransformerLM(nn.Module):
     required in decode mode, where each single-token call sits at the
     current cache index (see :mod:`ray_lightning_tpu.models.generate`).
 
+    ``kv_positions`` (B, 1) switches the decode KV cache to per-row
+    writes at explicit absolute positions (ragged batches where rows sit
+    at different lengths); leave None for the shared-index path (uniform
+    decode steps and block prefill).
+
     ``return_hidden=True`` returns the final hidden states (after
     ``ln_f``) instead of logits, for the chunked LM-head loss path
     (:func:`ray_lightning_tpu.ops.lm_head_loss.chunked_lm_head_xent`)
@@ -441,7 +491,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True, positions=None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, kv_positions=None):
         cfg = self.cfg
         B, T = tokens.shape
         if positions is None:  # decode mode passes cache-index positions
@@ -455,7 +505,7 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wpe")(pos)
         x = TransformerStack(cfg, name="stack")(
-            x, deterministic=deterministic)
+            x, deterministic=deterministic, kv_positions=kv_positions)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x

@@ -180,6 +180,25 @@ def test_ring_scatter_gather_noncontiguous():
 
 
 @needs_native
+def test_push_buffers_raw_strided_segments():
+    """``push_buffers`` handed a raw strided memoryview/array directly
+    (not through _pack_frames' pickle path) must normalize it to
+    contiguous bytes instead of surfacing np.frombuffer's confusing
+    low-level raise."""
+    r = ShmRing(f"/tl_t_{os.getpid()}_sgraw", capacity=1 << 20)
+    try:
+        contig = np.arange(16 * 4, dtype=np.int32).reshape(16, 4)
+        strided = contig.T                      # not C-contiguous
+        r.push_buffers([b"hdr", memoryview(strided), contig[::2]])
+        got = r.pop()
+        expect = (b"hdr" + np.ascontiguousarray(strided).tobytes()
+                  + np.ascontiguousarray(contig[::2]).tobytes())
+        assert got == expect
+    finally:
+        r.destroy()
+
+
+@needs_native
 def test_ring_scatter_gather_wraparound():
     """push_buffers honors the same wrap-marker framing as push: messages
     assembled from segments survive many trips around a small ring."""

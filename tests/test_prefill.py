@@ -1,0 +1,192 @@
+"""Prefill/decode-split equivalence: the batched prompt-fill program +
+tokens-only scan must reproduce the legacy teacher-forced full scan
+token-for-token (greedy), in every layout and raggedness combination.
+
+The KV cache block-write contract (transformer.py `_decode_cache` T>1
+path) and the per-row write path (`kv_positions`) are pinned here too —
+they are what make the split possible.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.models.generate import (generate, generate_full_scan,
+                                               prefill)
+
+
+def _nano(scan_layers, **over):
+    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
+              scan_layers=scan_layers)
+    mk.update(over)
+    train_cfg = gpt2_config("nano", **mk)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(train_cfg).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+@pytest.mark.parametrize("eos", [None, "measured"])
+def test_prefill_scan_matches_legacy_uniform(scan_layers, eos):
+    """Uniform-length prompts: the split path's (B, P+N) output must be
+    bit-identical to the legacy all-scan path, with and without eos."""
+    dec, params = _nano(scan_layers)
+    prompt = np.array([[5, 17, 3, 9], [9, 2, 44, 1]], np.int32)
+    kw = dict(max_new_tokens=6, rng=jax.random.PRNGKey(1), temperature=0.0)
+    if eos == "measured":
+        # greedy-run first, then declare the first emitted token eos so
+        # the stop path is actually exercised
+        free = np.asarray(generate_full_scan(dec, params, prompt, **kw))
+        kw["eos_id"] = int(free[0, 4])
+    new = np.asarray(generate(dec, params, prompt, **kw))
+    old = np.asarray(generate_full_scan(dec, params, prompt, **kw))
+    assert np.array_equal(new, old)
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+@pytest.mark.parametrize("eos", [None, "measured"])
+def test_prefill_scan_matches_legacy_variable_length(scan_layers, eos):
+    """Ragged prompts: each row's max_new_tokens-token window must match
+    the legacy path exactly (the legacy path keeps generating past the
+    window for short rows; the split path stops — only the window is the
+    shared contract)."""
+    dec, params = _nano(scan_layers)
+    batch = np.zeros((2, 4), np.int32)
+    batch[0, :4] = [5, 17, 3, 9]
+    batch[1, :2] = [42, 7]
+    lengths = np.array([4, 2], np.int32)
+    n = 5
+    kw = dict(max_new_tokens=n, rng=jax.random.PRNGKey(3), temperature=0.0,
+              prompt_lengths=lengths)
+    if eos == "measured":
+        free = np.asarray(generate_full_scan(dec, params, batch, **kw))
+        kw["eos_id"] = int(free[1, 2])  # short row's first emitted token
+    new = np.asarray(generate(dec, params, batch, **kw))
+    old = np.asarray(generate_full_scan(dec, params, batch, **kw))
+    for i, L in enumerate(lengths):
+        assert np.array_equal(new[i, :L + n], old[i, :L + n]), (i, new, old)
+
+
+def test_prefill_cache_matches_sequential_feed():
+    """The block cache write (one (B,P) forward) must leave the same KV
+    cache as feeding the prompt one token at a time — the contract change
+    from 'exactly one new position per call' to 'a block of positions'."""
+    dec, params = _nano(scan_layers=False)
+    prompt = jnp.asarray(np.array([[5, 17, 3, 9], [9, 2, 44, 1]],
+                                  np.int32))
+    cache_block, last_block = prefill(dec, params, prompt)
+
+    cache = dec.init(jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+                     positions=jnp.zeros((2, 1), jnp.int32))["cache"]
+    for t in range(prompt.shape[1]):
+        logits, upd = dec.apply(
+            {"params": params, "cache": cache}, prompt[:, t:t + 1],
+            positions=jnp.full((2, 1), t, jnp.int32), mutable=["cache"])
+        cache = upd["cache"]
+
+    flat_a = jax.tree_util.tree_leaves_with_path(cache_block)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(cache))
+    for path, leaf in flat_a:
+        ref = flat_b[path]
+        name = str(path[-1])
+        if "cache_index" in name:
+            assert int(leaf) == int(ref) == prompt.shape[1]
+        else:
+            np.testing.assert_allclose(np.asarray(leaf), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-6, err_msg=name)
+    np.testing.assert_allclose(np.asarray(last_block),
+                               np.asarray(logits[:, -1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prefill_sampling_stays_in_vocab_and_validates():
+    """The split path keeps generate()'s validation contract and its
+    sampling path produces in-vocab tokens of the right shape."""
+    dec, params = _nano(scan_layers=False)
+    prompt = np.array([[1, 2]], np.int32)
+    s = generate(dec, params, prompt, max_new_tokens=8,
+                 rng=jax.random.PRNGKey(4), temperature=1.0, top_k=8)
+    assert int(np.asarray(s).max()) < 128 and s.shape == (1, 10)
+    # max_new_tokens=1: the scan program is skipped entirely
+    one = generate(dec, params, prompt, max_new_tokens=1,
+                   rng=jax.random.PRNGKey(5), temperature=0.0)
+    ref = generate_full_scan(dec, params, prompt, max_new_tokens=1,
+                             rng=jax.random.PRNGKey(5), temperature=0.0)
+    assert np.array_equal(np.asarray(one), np.asarray(ref))
+
+    train_cfg = gpt2_config("nano", vocab_size=128, max_seq_len=32,
+                            dtype=jnp.float32)
+    with pytest.raises(ValueError, match="decode=True"):
+        generate(TransformerLM(train_cfg), params, prompt,
+                 max_new_tokens=4, rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(dec, params, prompt, max_new_tokens=31,
+                 rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        prefill(dec, params, jnp.zeros((1, 33), jnp.int32))
+
+
+def test_prefill_from_unstacked_training_weights():
+    """The serving recipe end-to-end: scanned training weights →
+    unstack_scan_params → unrolled decode model → split-path generate,
+    identical to the legacy path on the same weights."""
+    from ray_lightning_tpu.models.transformer import unstack_scan_params
+
+    cfg_scan = gpt2_config("nano", vocab_size=128, max_seq_len=24,
+                           scan_layers=True, dtype=jnp.float32)
+    params = TransformerLM(cfg_scan).init(
+        jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))["params"]
+    dec_cfg = dataclasses.replace(cfg_scan, decode=True,
+                                  scan_layers=False, scan_unroll=1)
+    dec, loop_params = TransformerLM(dec_cfg), unstack_scan_params(params)
+    prompt = np.array([[3, 7, 11, 2]], np.int32)
+    new = generate(dec, loop_params, prompt, max_new_tokens=6,
+                   rng=jax.random.PRNGKey(2), temperature=0.0)
+    old = generate_full_scan(dec, loop_params, prompt, max_new_tokens=6,
+                             rng=jax.random.PRNGKey(2), temperature=0.0)
+    assert np.array_equal(np.asarray(new), np.asarray(old))
+
+
+def test_moe_prefill_scan_matches_legacy():
+    """MoE LMs return (logits, aux); the prefill path must unpack the
+    tuple and stay token-identical to the legacy scan at overflow-free
+    capacity (capacity scales with the forward's token count, so only
+    with headroom for every token is equality an invariant)."""
+    from ray_lightning_tpu.models import MoeTransformerLM, moe_config
+
+    mk = dict(vocab_size=64, max_seq_len=16, dtype=jnp.float32,
+              capacity_factor=float(16))
+    dec = MoeTransformerLM(moe_config("nano", decode=True, **mk))
+    params = MoeTransformerLM(moe_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.array([[3, 9]], np.int32))["params"]
+    prompt = np.array([[3, 9, 1], [7, 2, 0]], np.int32)
+    kw = dict(max_new_tokens=4, rng=jax.random.PRNGKey(1), temperature=0.0)
+    new = np.asarray(generate(dec, params, prompt, **kw))
+    old = np.asarray(generate_full_scan(dec, params, prompt, **kw))
+    assert np.array_equal(new, old)
+    # ragged MoE rides the per-row kv_positions path
+    lengths = np.array([3, 2], np.int32)
+    newr = np.asarray(generate(dec, params, prompt, prompt_lengths=lengths,
+                               **kw))
+    oldr = np.asarray(generate_full_scan(dec, params, prompt,
+                                         prompt_lengths=lengths, **kw))
+    for i, L in enumerate(lengths):
+        assert np.array_equal(newr[i, :L + 4], oldr[i, :L + 4])
+
+
+def test_stack_scan_params_rejects_layers_collision():
+    """A literal 'layers' key next to block_i siblings must raise instead
+    of silently dropping one of the subtrees."""
+    from ray_lightning_tpu.models.transformer import stack_scan_params
+
+    params = {
+        "block_0": {"w": jnp.ones((2,))},
+        "block_1": {"w": jnp.ones((2,))},
+        "layers": {"w": jnp.zeros((3,))},
+    }
+    with pytest.raises(ValueError, match="literal 'layers'"):
+        stack_scan_params(params)
