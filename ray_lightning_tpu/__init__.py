@@ -12,6 +12,24 @@ Public API parity (``ray_lightning/__init__.py:1-5``): ``RayStrategy``,
 and the Trainer/module stack the reference borrows from PyTorch Lightning.
 """
 
+import os as _os
+
+import jax as _jax
+
+# Sharding-invariant PRNG (the default on newer jax): without it, a jitted
+# init whose out_shardings shard a leaf (e.g. pipeline_parallel_rule's
+# pp-sharded block stacks) generates DIFFERENT random values than the same
+# init replicated, so "same seed, any layout" equivalence silently breaks
+# (caught by tests/test_pipeline.py::test_pipelined_lm_trains_on_dp_x_pp).
+# This is process-global: on older jax it also changes the stream of the
+# application's OWN jax.random draws (to the values newer jax produces by
+# default). TL_THREEFRY_PARTITIONABLE=0 opts out, accepting
+# layout-dependent init instead. No-op where the flag no longer exists
+# (partitionable is then the only implementation).
+if (_os.environ.get("TL_THREEFRY_PARTITIONABLE", "1") != "0"
+        and hasattr(_jax.config, "jax_threefry_partitionable")):
+    _jax.config.update("jax_threefry_partitionable", True)
+
 from ray_lightning_tpu.strategies import (RayStrategy, DataParallelStrategy,
                                           RayShardedStrategy, ZeroOneStrategy,
                                           HorovodRayStrategy,

@@ -1,0 +1,58 @@
+"""Version compatibility shims for the moving parts of the jax API.
+
+One import site per migrating symbol, so a jax upgrade is absorbed here
+instead of across every module that uses it.
+
+``shard_map``: promoted out of ``jax.experimental`` upstream — newer
+releases expose it as ``jax.shard_map`` (with the replication check
+renamed ``check_rep`` → ``check_vma``) and eventually drop the
+experimental path; older releases have only the experimental path. This
+module exports the new-API surface either way — call sites write
+``check_vma=`` and the shim translates for old runtimes. Import it from
+here::
+
+    from ray_lightning_tpu._compat import shard_map
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+if callable(getattr(jax, "shard_map", None)):
+    # post-promotion releases: the top-level export is the one true
+    # spelling and already speaks check_vma
+    shard_map = jax.shard_map
+else:  # pre-promotion releases: experimental path, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    @functools.wraps(_exp_shard_map)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _exp_shard_map(f, *args, **kwargs)
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        """``jax.lax.axis_size`` for releases that predate it: the size of
+        a mapped mesh axis, computed as a counting ``psum`` (a compile-time
+        constant, not a runtime collective)."""
+        return jax.lax.psum(1, axis_name)
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` across its three spellings:
+    the public predicate (newest), the public ``global_state`` attribute
+    (middle), and the private module state (releases like 0.4.37 that
+    expose neither — a compat shim is the one place a ``jax._src`` import
+    is acceptable)."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        from jax._src.distributed import global_state as state
+    return getattr(state, "client", None) is not None
+
+
+__all__ = ["shard_map", "axis_size", "distributed_is_initialized"]

@@ -1,7 +1,8 @@
 from ray_lightning_tpu.models.boring import BoringModel, XORModel, XORDataModule
 from ray_lightning_tpu.models.mnist import (LightningMNISTClassifier,
                                             MNISTClassifier)
-from ray_lightning_tpu.models.transformer import (tensor_parallel_rule,
+from ray_lightning_tpu.models.transformer import (latch_eos,
+                                                  tensor_parallel_rule,
                                                   TransformerConfig,
                                                   TransformerLM,
                                                   TransformerEncoder)
@@ -18,8 +19,10 @@ from ray_lightning_tpu.models.vit import (ViTClassifier, ViTModule,
                                           vit_config)
 from ray_lightning_tpu.models.seq2seq import (Seq2SeqModule,
                                               Seq2SeqTransformer)
-from ray_lightning_tpu.models.generate import (generate, generate_full_scan,
-                                               prefill, sample_logits)
+from ray_lightning_tpu.models.generate import (decode_step, generate,
+                                               generate_full_scan, prefill,
+                                               sample_logits,
+                                               sample_logits_rows)
 
 __all__ = [
     "BoringModel", "XORModel", "XORDataModule", "LightningMNISTClassifier",
@@ -29,7 +32,8 @@ __all__ = [
     "resnet10", "resnet18", "resnet50", "MoeConfig", "MoeModule", "MoeTransformerLM",
     "expert_parallel_rule", "moe_config", "PipelinedLMModule",
     "PipelinedTransformerLM", "ViTClassifier", "ViTModule", "vit_config",
-    "generate", "generate_full_scan", "prefill", "sample_logits",
+    "decode_step", "generate", "generate_full_scan", "prefill",
+    "sample_logits", "sample_logits_rows", "latch_eos",
     "tensor_parallel_rule",
     "Seq2SeqModule", "Seq2SeqTransformer"
 ]

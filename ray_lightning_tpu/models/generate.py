@@ -72,6 +72,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ray_lightning_tpu.models.transformer import latch_eos
+
 
 def sample_logits(logits: jax.Array, rng: jax.Array,
                   temperature: float = 1.0,
@@ -89,6 +91,61 @@ def sample_logits(logits: jax.Array, rng: jax.Array,
         logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min,
                            logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_rows(logits: jax.Array, keys: jax.Array,
+                       temperature: jax.Array,
+                       top_k: jax.Array) -> jax.Array:
+    """Per-row sampling from (B, V) logits — the batched-heterogeneous
+    sibling of :func:`sample_logits` for the serving engine, where every
+    slot carries its own request's sampling params.
+
+    ``keys`` (B, 2) is one explicit PRNG key per row (the engine derives
+    row r's key as ``fold_in(fold_in(base, request_seed), step)``, so a
+    request's sample stream depends only on its seed and step index —
+    reproducible across slot assignments and batch compositions, and never
+    shared between co-resident slots). ``temperature`` (B,) with 0 = greedy
+    argmax for that row (bit-identical to :func:`sample_logits`'s greedy).
+    ``top_k`` (B,) int with 0 = unrestricted; a *traced* per-row k cannot
+    use ``lax.top_k`` (static k), so the mask comes from ranks of a
+    descending argsort — same "keep the k highest" semantics with k dynamic
+    (ties broken by sort order rather than kept, which only reweights
+    exactly-tied tail logits).
+
+    The expensive machinery is gated at the BATCH level with ``lax.cond``
+    (outside the vmap, so XLA executes one branch at runtime): an
+    all-greedy batch — the tracked serving bench, and any temperature=0
+    deployment — pays one argmax, no per-row categorical; the full-vocab
+    argsort additionally engages only when some row actually restricts
+    top_k. Per-row greedy/sampled mixing stays inside the sampled branch.
+    """
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+
+    def rows_greedy():
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def rows_sampled(use_topk: bool):
+        def row(l, k, t, tk):
+            greedy = jnp.argmax(l).astype(jnp.int32)
+            scaled = l / jnp.where(t > 0, t, 1.0)
+            if use_topk:
+                order = jnp.argsort(-l)
+                ranks = jnp.zeros_like(order).at[order].set(
+                    jnp.arange(l.shape[0], dtype=order.dtype))
+                scaled = jnp.where((tk > 0) & (ranks >= tk),
+                                   jnp.finfo(jnp.float32).min, scaled)
+            sampled = jax.random.categorical(k, scaled).astype(jnp.int32)
+            return jnp.where(t > 0, sampled, greedy)
+
+        return jax.vmap(row)(logits, keys, temperature, top_k)
+
+    return jax.lax.cond(
+        jnp.any(temperature > 0.0),
+        lambda: jax.lax.cond(jnp.any(top_k > 0),
+                             lambda: rows_sampled(True),
+                             lambda: rows_sampled(False)),
+        rows_greedy)
 
 
 def _check_decode_model(model, P: int, max_new_tokens: int = 0) -> None:
@@ -115,6 +172,32 @@ def _row_update(rows: jax.Array, vals: jax.Array,
     return jax.vmap(
         lambda row, val, i: jax.lax.dynamic_update_slice(row, val, (i,)))(
             rows, vals, starts)
+
+
+def decode_step(model, params, cache, tokens: jax.Array,
+                kv_positions: jax.Array):
+    """ONE cached single-token decode step at explicit per-row positions —
+    the shared core between :func:`generate`'s ragged decode scan and the
+    serving engine's continuous-batching step
+    (:mod:`ray_lightning_tpu.serve.engine`), so the two paths cannot
+    drift.
+
+    ``tokens`` (B, 1) holds each row's current token, ``kv_positions``
+    (B, 1) its absolute sequence position: the step writes each row's K/V
+    at its own slot (the per-row ``_decode_cache`` mode) and masks keys
+    beyond it — rows at *different* sequence lengths share one compiled
+    program, which is what lets the engine swap requests in and out of
+    batch rows without recompiling.
+
+    Returns ``(last_logits (B, V), cache)``. Sampling stays outside (the
+    scan and the engine consume the logits differently — shared rng for a
+    homogeneous batch vs per-request keys and sampling params).
+    """
+    outputs, updated = model.apply(
+        {"params": params, "cache": cache}, tokens,
+        positions=kv_positions, kv_positions=kv_positions,
+        deterministic=True, mutable=["cache"])
+    return _logits_only(outputs)[:, -1], updated["cache"]
 
 
 def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
@@ -201,34 +284,32 @@ def _decode_scan(model, cache, tokens, params, lengths, rng, done0, *,
         cache, tokens, rng, done = carry
         if ragged:
             # rows sit at different lengths: read/write at per-row
-            # positions; the cache writes are per-row too (kv_positions)
+            # positions — the shared decode_step (also the serving
+            # engine's model step) does the per-row kv_positions write
             pos = (lengths + s)[:, None]
             cur = jnp.take_along_axis(tokens, pos, axis=1)
-            outputs, updated = model.apply(
-                {"params": params, "cache": cache}, cur, positions=pos,
-                kv_positions=pos, deterministic=True, mutable=["cache"])
+            last, cache = decode_step(model, params, cache, cur, pos)
         else:
             t = total - steps - 1 + s
             cur = jax.lax.dynamic_slice_in_dim(tokens, t, 1, axis=1)
             pos = jnp.full((B, 1), t, jnp.int32)
-            outputs, updated = model.apply(
+            outputs, cache_vars = model.apply(
                 {"params": params, "cache": cache}, cur, positions=pos,
                 deterministic=True, mutable=["cache"])
-        logits = _logits_only(outputs)
+            last, cache = _logits_only(outputs)[:, -1], cache_vars["cache"]
         rng, sub = jax.random.split(rng)
-        nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+        nxt = sample_logits(last, sub, temperature, top_k)
         if eos_id is not None:
             # every scanned step samples strictly past the prompt, so
             # (unlike the teacher-forced legacy scan) latching needs no
             # "generating" gate
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
+            nxt, done = latch_eos(nxt, done, eos_id)
         if ragged:
             tokens = _row_update(tokens, nxt[:, None], lengths + s + 1)
         else:
             tokens = jax.lax.dynamic_update_slice_in_dim(
                 tokens, nxt[:, None], total - steps + s, axis=1)
-        return (updated["cache"], tokens, rng, done), None
+        return (cache, tokens, rng, done), None
 
     (_, tokens, _, _), _ = jax.lax.scan(
         step, (cache, tokens, rng, done0), jnp.arange(steps))

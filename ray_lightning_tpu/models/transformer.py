@@ -318,6 +318,27 @@ class _ScanBlock(nn.Module):
         return (x, mask, kv_positions), None
 
 
+def latch_eos(next_tokens: jax.Array, done: jax.Array, eos_id):
+    """Per-row eos latching shared by ``generate()``'s decode scan and the
+    serving engine's step program.
+
+    Rows already ``done`` keep emitting their eos id (static shapes: the
+    program runs full length, finished rows must repeat a harmless token);
+    rows that just sampled eos latch ``done``. ``eos_id`` is a scalar or a
+    per-row ``(B,)`` int array — negative entries disable eos handling for
+    that row (the serving engine's "no eos" sentinel, since a traced
+    per-row id cannot be ``None``).
+
+    Returns ``(tokens, done)`` — tokens with done rows pinned to eos, and
+    the updated latch.
+    """
+    eos = jnp.asarray(eos_id, jnp.int32)
+    has_eos = eos >= 0
+    out = jnp.where(done & has_eos, eos, next_tokens)
+    done = done | (has_eos & (out == eos))
+    return out, done
+
+
 def check_seq_len(cfg: TransformerConfig, length: int,
                   what: str = "sequence") -> None:
     """Trace-time guard shared by every model family with learned

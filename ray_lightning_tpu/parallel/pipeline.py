@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_lightning_tpu._compat import axis_size, shard_map
+
 PP_AXIS_NAME = "pp"
 
 # Mesh registered by the trainer (worker-side, at step-build time) — the
@@ -123,7 +125,7 @@ def pipelined_stack(layer_fn: Callable[[Any, jax.Array], jax.Array],
         return out.reshape(xb.shape)
 
     spec_x = P(data_axes if data_axes else None)
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(PP_AXIS_NAME), spec_x), out_specs=spec_x,
         check_vma=False)
@@ -152,7 +154,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         psum selects the last stage's retired activations).
     """
     stage = jax.lax.axis_index(axis_name)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     M = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
     total_ticks = M + n_stages - 1

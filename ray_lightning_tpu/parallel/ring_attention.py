@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_lightning_tpu._compat import axis_size, shard_map
 from ray_lightning_tpu.ops.attention import dot_product_attention
 from ray_lightning_tpu.ops.flash_attention import (_BIG_NEG, _block_update,
                                                    _finalize)
@@ -93,7 +94,7 @@ def sp_sharded_attention(q: jax.Array,
             and q.shape[2] % mesh.shape["tp"] == 0:
         head_axis = "tp"
     spec = P(data_axes if data_axes else None, SP_AXIS_NAME, head_axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda a, b, c: ring_attention(a, b, c, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
@@ -119,7 +120,7 @@ def ring_attention(q: jax.Array,
     del softmax_dtype
     try:
         my_rank = jax.lax.axis_index(axis_name)
-        n = jax.lax.axis_size(axis_name)
+        n = axis_size(axis_name)
     except NameError:
         return dot_product_attention(
             q, k, v, causal=causal, mask=mask, dropout_rate=dropout_rate,

@@ -1,0 +1,27 @@
+"""Continuous-batching serving on top of the prefill/decode split.
+
+See ``docs/serving.md`` for the architecture. Quick start::
+
+    from ray_lightning_tpu.serve import ServeClient
+
+    client = ServeClient(decode_model, params, num_slots=8,
+                         prefill_len=64)
+    rid = client.submit(prompt_tokens, max_new_tokens=32, eos_id=50256)
+    out = client.run_until_idle()[rid]
+    print(out.tokens, out.finish_reason)
+"""
+from ray_lightning_tpu.serve.client import ServeClient
+from ray_lightning_tpu.serve.engine import (KVSlotPool, ServeEngine,
+                                            SlotPoolFull)
+from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
+                                             FINISH_LENGTH, FINISH_REJECTED,
+                                             FINISH_TIMEOUT, Request)
+from ray_lightning_tpu.serve.scheduler import (FifoScheduler, QueueFull,
+                                               SchedulerConfig)
+
+__all__ = [
+    "ServeClient", "ServeEngine", "KVSlotPool", "SlotPoolFull",
+    "Request", "Completion", "FifoScheduler", "QueueFull",
+    "SchedulerConfig", "FINISH_EOS", "FINISH_LENGTH", "FINISH_REJECTED",
+    "FINISH_TIMEOUT",
+]

@@ -1,0 +1,212 @@
+"""Synchronous driver loop: engine + scheduler + clock.
+
+:class:`ServeClient` is the single-threaded event loop the tests, the
+example, and the bench all drive: submit requests (immediately or from an
+arrival trace), then tick — each tick expires deadlines, asks the
+scheduler for the next dispatch (prefill / step / idle), runs it, and
+stamps completion timing.
+
+Two clock modes:
+
+- **tick clock** (default, ``clock=None``): time = number of engine
+  dispatches so far. Fully deterministic — arrival traces expressed in
+  ticks replay bit-identically, which is what the serving smoke tests
+  pin ("request 3 arrives after the 5th engine dispatch, mid-flight").
+- **wall clock** (``clock=time.perf_counter`` or any callable): real
+  latencies for the bench; arrival times are seconds from ``run`` start.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_lightning_tpu.serve.engine import ServeEngine
+from ray_lightning_tpu.serve.request import (Completion, FINISH_REJECTED,
+                                             FINISH_TIMEOUT, Request)
+from ray_lightning_tpu.serve.scheduler import (ACTION_PREFILL, ACTION_STEP,
+                                               FifoScheduler, QueueFull,
+                                               SchedulerConfig)
+
+
+class ServeClient:
+    """Synchronous continuous-batching front-end.
+
+    ``ServeClient(model, params, num_slots=8, prefill_len=64)`` builds the
+    engine and a FIFO scheduler; ``submit()`` returns a request id,
+    ``run_until_idle()`` drives everything to completion, and
+    ``serve_trace([(t, {...}), ...])`` replays a scripted arrival trace
+    (requests join mid-flight whenever ``t`` falls between dispatches).
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 8,
+                 prefill_batch: Optional[int] = None,
+                 prefill_len: int = 64, steps_per_dispatch: int = 1,
+                 scheduler_config: Optional[SchedulerConfig] = None,
+                 seed: int = 0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.engine = ServeEngine(
+            model, params, num_slots=num_slots,
+            prefill_batch=prefill_batch, prefill_len=prefill_len,
+            steps_per_dispatch=steps_per_dispatch, seed=seed)
+        self.scheduler = FifoScheduler(scheduler_config)
+        self._clock = clock
+        self._t0: Optional[float] = None
+        self._ops = 0  # engine dispatches so far = the tick clock
+        self._next_id = 0
+        self.completions: Dict[int, Completion] = {}
+
+    # ------------------------------------------------------------ clock
+    @property
+    def ops(self) -> int:
+        return self._ops
+
+    def now(self) -> float:
+        if self._clock is None:
+            return float(self._ops)
+        if self._t0 is None:
+            self._t0 = self._clock()
+        return self._clock() - self._t0
+
+    # ----------------------------------------------------------- submit
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               eos_id: Optional[int] = None, seed: Optional[int] = None,
+               deadline: Optional[float] = None) -> int:
+        """Validate + enqueue one request; returns its id. Raises
+        ``ValueError`` for requests that can never fit the compiled
+        shapes and :class:`~...scheduler.QueueFull` at max queue depth."""
+        req = Request(id=self._next_id, prompt=prompt,
+                      max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k, eos_id=eos_id,
+                      seed=seed, deadline=deadline)
+        self.engine.validate(req)
+        now = self.now()
+        self.scheduler.submit(req, now)
+        req.arrival_time = now
+        self._next_id += 1
+        return req.id
+
+    # ------------------------------------------------------------- loop
+    def tick(self) -> List[Completion]:
+        """One scheduling decision + engine dispatch. Returns completions
+        retired by this tick (including deadline expirations)."""
+        now = self.now()
+        done: List[Completion] = []
+        # queued requests past deadline never touch the accelerator
+        for req in self.scheduler.expire(now):
+            done.append(Completion(
+                request_id=req.id, prompt=list(req.prompt), tokens=[],
+                finish_reason=FINISH_TIMEOUT,
+                arrival_time=req.arrival_time))
+        # in-flight requests past deadline free their slot mid-decode
+        for req in list(self.engine.active_requests.values()):
+            if req.deadline is not None and now >= req.deadline:
+                comp = self.engine.cancel(req.id)
+                if comp is not None:
+                    done.append(comp)
+        action, reqs = self.scheduler.next_action(self.engine)
+        if action == ACTION_PREFILL:
+            # defer (don't crash on) requests whose seed collides with an
+            # in-flight sample stream — the pool would refuse them at
+            # acquire; they rejoin the queue head and clear once the
+            # conflicting request retires. Intra-batch duplicates keep
+            # their first arrival, so at least one request always admits.
+            seen = {r.seed for r in self.engine.active_requests.values()}
+            admit: List[Request] = []
+            deferred: List[Request] = []
+            for req in reqs:
+                (deferred if req.seed in seen else admit).append(req)
+                seen.add(req.seed)
+            if deferred:
+                self.scheduler.requeue_front(deferred)
+            if admit:
+                done.extend(self.engine.prefill(admit))
+                self._ops += 1  # count the dispatch before stamping TTFT
+                t_first = self.now()
+                for req in admit:
+                    req.first_token_time = t_first
+            elif self.engine.active_count:
+                done.extend(self.engine.step())
+                self._ops += 1
+            else:  # unreachable: an idle engine always admits the head
+                self._ops += 1
+        elif action == ACTION_STEP:
+            done.extend(self.engine.step())
+            self._ops += 1
+        else:  # idle: advance the tick clock so tick-mode traces progress
+            self._ops += 1
+        t_done = self.now()
+        for comp in done:
+            comp.finish_time = t_done
+            if comp.first_token_time is None and comp.tokens:
+                # finished at its own prefill, before the post-dispatch
+                # stamping loop ran for it
+                comp.first_token_time = t_done
+            self.completions[comp.request_id] = comp
+        return done
+
+    def run_until_idle(self, max_ticks: int = 100_000) \
+            -> Dict[int, Completion]:
+        """Tick until queue and slots drain; returns all completions."""
+        ticks = 0
+        while len(self.scheduler) or self.engine.active_count:
+            self.tick()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"serve loop did not drain in {max_ticks} ticks")
+        return dict(self.completions)
+
+    def serve_trace(self, trace: Sequence[Tuple[float, dict]],
+                    max_ticks: int = 100_000) -> Dict[int, Completion]:
+        """Replay a scripted arrival trace.
+
+        ``trace`` is ``[(arrival_time, submit_kwargs), ...]`` in the
+        client's clock units (ticks by default — deterministic; seconds
+        under a wall clock). Requests are submitted the first tick at or
+        after their arrival time, so later entries join mid-flight while
+        earlier requests are still decoding. Returns ``{request_id:
+        Completion}`` with ids assigned in trace order. An entry the
+        admission layer refuses (queue at depth, prompt that can never
+        fit) is SHED — recorded as a ``finish_reason="rejected"``
+        completion — instead of aborting the replay and discarding every
+        other request's work (overload sheds requests, not the server).
+        """
+        pending = sorted(trace, key=lambda item: item[0])
+        idx = 0
+        ticks = 0
+        while (idx < len(pending) or len(self.scheduler)
+               or self.engine.active_count):
+            now = self.now()
+            while idx < len(pending) and pending[idx][0] <= now:
+                kwargs = pending[idx][1]
+                try:
+                    self.submit(**kwargs)
+                except (QueueFull, ValueError):
+                    rid = self._next_id
+                    self._next_id += 1
+                    self.completions[rid] = Completion(
+                        request_id=rid,
+                        prompt=[int(t) for t in kwargs.get("prompt", [])],
+                        tokens=[], finish_reason=FINISH_REJECTED,
+                        arrival_time=now, finish_time=now)
+                idx += 1
+            if (idx < len(pending) and not len(self.scheduler)
+                    and not self.engine.active_count):
+                # nothing in flight and the next arrival is in the
+                # future: fast-forward the tick clock / yield the wall
+                # clock instead of spinning
+                if self._clock is None:
+                    self._ops = max(self._ops,
+                                    math.ceil(pending[idx][0]))
+                else:
+                    time.sleep(
+                        min(1e-3, max(0.0, pending[idx][0] - now)))
+                continue
+            self.tick()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"serve trace did not drain in {max_ticks} ticks")
+        return dict(self.completions)

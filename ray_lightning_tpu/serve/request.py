@@ -1,0 +1,96 @@
+"""Request/Completion dataclasses for the continuous-batching engine.
+
+A :class:`Request` is one user generation call: its prompt, a per-request
+token budget, and per-request sampling params — each batch row of the
+engine's step program carries its *own* temperature/top_k/eos/seed, so
+heterogeneous requests share one compiled program. ``max_new_tokens`` is a
+per-row countdown inside the engine step (not a static scan length like
+one-shot :func:`~ray_lightning_tpu.models.generate.generate`): a row
+retires the moment it hits eos or exhausts its budget, and its KV slot is
+handed to the next queued request mid-flight.
+
+A :class:`Completion` is the retired request: the generated tokens (eos
+included when sampled), why it stopped, and the latency breakdown the
+serving bench aggregates into p50/p99.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+FINISH_EOS = "eos"            # sampled its eos id
+FINISH_LENGTH = "length"      # exhausted max_new_tokens
+FINISH_TIMEOUT = "timeout"    # deadline expired (queued or mid-decode)
+FINISH_REJECTED = "rejected"  # shed at admission (trace replay only)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``seed`` defaults to the request id: the engine derives every sample
+    key as ``fold_in(fold_in(engine_base, seed), step)``, so a request's
+    token stream with ``temperature > 0`` is a pure function of
+    ``(engine seed, request seed, step)`` — reproducible across arrival
+    orders, slot assignments, and batch compositions. Distinct co-resident
+    seeds are asserted at slot assignment (no key reuse across slots).
+
+    ``deadline``: optional absolute clock value (in the driving client's
+    clock units) after which the request is abandoned — dropped from the
+    queue, or cancelled mid-decode with the tokens produced so far.
+    """
+    id: int
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    eos_id: Optional[int] = None
+    seed: Optional[int] = None
+    deadline: Optional[float] = None
+    # timing bookkeeping, stamped by the driving client (clock units)
+    arrival_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+
+    def __post_init__(self):
+        self.prompt = [int(t) for t in self.prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.seed is None:
+            self.seed = self.id
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+
+@dataclasses.dataclass
+class Completion:
+    """A retired request: output tokens + stop reason + latency stamps."""
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]               # generated tokens, eos included
+    finish_reason: str              # FINISH_EOS | FINISH_LENGTH | FINISH_TIMEOUT
+    arrival_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def latency(self) -> Optional[float]:
+        """Arrival → completion, in the driving client's clock units."""
+        if self.arrival_time is None or self.finish_time is None:
+            return None
+        return self.finish_time - self.arrival_time
+
+    @property
+    def time_to_first_token(self) -> Optional[float]:
+        if self.arrival_time is None or self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time

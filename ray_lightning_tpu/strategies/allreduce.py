@@ -4,7 +4,9 @@ The reference's ``HorovodRayStrategy`` (``ray_lightning/ray_horovod.py:32-
 183``) is DP where gradient sync is *explicit* — Horovod's
 ``DistributedOptimizer`` all-reduces on ``step()`` rather than DDP hooking
 backward. The TPU-native equivalent keeps that per-rank programming model:
-the step runs under ``jax.shard_map`` so each mesh slot computes grads on
+the step runs under ``shard_map`` (via ``ray_lightning_tpu._compat``, which
+absorbs the experimental→top-level jax migration) so each mesh slot computes
+grads on
 its local batch shard, then explicitly ``lax.pmean``-s them over ``dp``
 before the optimizer update — the direct analog of ``hvd.allreduce``
 lowered to an XLA collective on ICI.
@@ -23,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ray_lightning_tpu._compat import shard_map
 from ray_lightning_tpu.parallel.mesh import DP_AXIS, MeshSpec
 from ray_lightning_tpu.strategies.base import Strategy
 
@@ -71,7 +74,7 @@ class HorovodRayStrategy(Strategy):
             return new_state, {"loss": loss, **logs}
 
         batch_spec = batch_sharding.spec
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_rank_step,
             mesh=mesh,
             in_specs=(P(), batch_spec),

@@ -154,11 +154,8 @@ class Strategy:
         if num_processes is None:
             num_processes = 1
         if coordinator_address is not None and num_processes > 1:
-            try:
-                already = jax.distributed.is_initialized()  # jax >= 0.4.34
-            except AttributeError:
-                already = getattr(
-                    jax.distributed.global_state, "client", None) is not None
+            from ray_lightning_tpu._compat import distributed_is_initialized
+            already = distributed_is_initialized()
             if not already:
                 jax.distributed.initialize(
                     coordinator_address=coordinator_address,

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_lightning_tpu._compat import shard_map
 from ray_lightning_tpu.ops.attention import dot_product_attention
 from ray_lightning_tpu.ops.flash_attention import flash_attention
 from ray_lightning_tpu.ops.pallas_flash import pallas_flash_attention
@@ -78,7 +79,7 @@ def test_ring_attention_matches_dot(causal):
     def local_fn(q, k, v):
         return ring_attention(q, k, v, causal=causal)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"), check_vma=False))(q, k, v)

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_lightning_tpu._compat import shard_map
 from ray_lightning_tpu.parallel.pipeline import (pipeline_apply,
                                                  split_microbatches)
 
@@ -38,7 +39,7 @@ def _serial_reference(params, x):
 
 
 def _pipelined(mesh, params, microbatches):
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, mb: pipeline_apply(_stage_fn, p, mb),
         mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False)
@@ -73,7 +74,7 @@ def test_pipeline_grads_match_serial():
     mb = split_microbatches(x, 8)
 
     def pipe_loss(params, mb):
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda p, m: pipeline_apply(_stage_fn, p, m),
             mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
             check_vma=False)
@@ -113,7 +114,7 @@ def test_pipelined_training_step_dp_x_pp():
                                      grads)
         return new, loss
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         local_step, mesh=mesh,
         in_specs=(P("pp"), P("dp"), P("dp")),
         out_specs=(P("pp"), P()),
@@ -155,7 +156,7 @@ def test_pipeline_rejects_shape_changing_stage():
     def bad_stage(p, x):
         return jnp.concatenate([x, x], axis=-1)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda p, mb: pipeline_apply(bad_stage, p, mb),
         mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
         check_vma=False)

@@ -178,6 +178,47 @@ def test_moe_prefill_scan_matches_legacy():
         assert np.array_equal(newr[i, :L + 4], oldr[i, :L + 4])
 
 
+def test_prefill_engine_edge_shapes():
+    """The shapes the serving engine leans on hardest: a P=1 prompt at
+    B=1, a ragged batch containing a length-1 row, and ragged
+    max_new_tokens=1 (prefill program only, per-row last logits)."""
+    dec, params = _nano(scan_layers=False)
+    one = np.array([[9]], np.int32)
+    kw = dict(rng=jax.random.PRNGKey(11), temperature=0.0)
+    new = generate(dec, params, one, max_new_tokens=5, **kw)
+    old = generate_full_scan(dec, params, one, max_new_tokens=5, **kw)
+    assert np.array_equal(np.asarray(new), np.asarray(old))
+
+    batch = np.zeros((2, 4), np.int32)
+    batch[0, :4] = [5, 17, 3, 9]
+    batch[1, :1] = [9]
+    lengths = np.array([4, 1], np.int32)
+    for n in (1, 4):
+        newr = np.asarray(generate(dec, params, batch, max_new_tokens=n,
+                                   prompt_lengths=lengths, **kw))
+        oldr = np.asarray(generate_full_scan(
+            dec, params, batch, max_new_tokens=n, prompt_lengths=lengths,
+            **kw))
+        for i, L in enumerate(lengths):
+            assert np.array_equal(newr[i, :L + n], oldr[i, :L + n]), (n, i)
+
+
+def test_prefill_eos_on_first_token():
+    """A row whose very FIRST sampled token is eos: the whole window
+    repeats eos and the split path matches the legacy scan — the engine
+    retires such a request at its own prefill."""
+    dec, params = _nano(scan_layers=False)
+    prompt = np.array([[5, 17, 3, 9], [42, 7, 1, 2]], np.int32)
+    kw = dict(max_new_tokens=5, rng=jax.random.PRNGKey(1), temperature=0.0)
+    free = np.asarray(generate_full_scan(dec, params, prompt, **kw))
+    eos = int(free[1, 4])  # row 1's first emitted token
+    kw["eos_id"] = eos
+    new = np.asarray(generate(dec, params, prompt, **kw))
+    old = np.asarray(generate_full_scan(dec, params, prompt, **kw))
+    assert np.array_equal(new, old)
+    assert list(new[1, 4:]) == [eos] * 5
+
+
 def test_stack_scan_params_rejects_layers_collision():
     """A literal 'layers' key next to block_i siblings must raise instead
     of silently dropping one of the subtrees."""

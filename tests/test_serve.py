@@ -1,0 +1,321 @@
+"""Continuous-batching engine: end-to-end smoke + contracts.
+
+The load-bearing assertion is greedy token-identity with one-shot
+``generate()`` — the engine runs the SAME factored decode step
+(``models/generate.py decode_step``) at per-row ``kv_positions``, so a
+request decoded mid-flight next to strangers, in whatever slot the pool
+hands it, must emit exactly the tokens the static batch would have. The
+rest pins the serving machinery: slot reuse over stale KV, per-request
+sampling determinism (no key reuse across slots), admission control, and
+deadline expiry — all CPU-safe on the nano GPT config with scripted
+(tick-clock) arrival traces.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.models.generate import generate
+from ray_lightning_tpu.serve import (FINISH_EOS, FINISH_LENGTH,
+                                     FINISH_REJECTED, FINISH_TIMEOUT,
+                                     QueueFull, SchedulerConfig,
+                                     ServeClient, ServeEngine, Request)
+from ray_lightning_tpu.serve.scheduler import (ACTION_PREFILL, ACTION_STEP,
+                                               FifoScheduler)
+
+
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+def _ref_windows(dec, params, prompts, n, eos_id=None):
+    """Per-request greedy reference from one-shot ragged generate():
+    each row's max_new_tokens window, truncated at its first eos
+    (inclusive) — the engine stops a row there instead of repeating."""
+    P = max(len(p) for p in prompts)
+    batch = np.zeros((len(prompts), P), np.int32)
+    lengths = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+    out = np.asarray(generate(
+        dec, params, batch, max_new_tokens=n, rng=jax.random.PRNGKey(7),
+        temperature=0.0, prompt_lengths=lengths, eos_id=eos_id))
+    windows = []
+    for i, L in enumerate(lengths):
+        w = list(out[i, L:L + n])
+        if eos_id is not None and eos_id in w:
+            w = w[:w.index(eos_id) + 1]
+        windows.append([int(t) for t in w])
+    return windows
+
+
+def test_serve_greedy_matches_generate_interleaved(nano):
+    """4 ragged requests through 3 slots with staggered arrivals: the
+    late requests join mid-flight (slot reuse included) and every
+    completion is token-identical to the static ragged batch."""
+    dec, params = nano
+    prompts = [[5, 17, 3, 9], [9, 2, 44], [42, 7], [1]]
+    n = 6
+    client = ServeClient(dec, params, num_slots=3, prefill_len=8)
+    out = client.serve_trace([
+        (0, dict(prompt=prompts[0], max_new_tokens=n)),
+        (0, dict(prompt=prompts[1], max_new_tokens=n)),
+        (3, dict(prompt=prompts[2], max_new_tokens=n)),
+        (5, dict(prompt=prompts[3], max_new_tokens=n)),
+    ])
+    ref = _ref_windows(dec, params, prompts, n)
+    for rid in range(4):
+        assert out[rid].tokens == ref[rid], (rid, out[rid].tokens, ref)
+        assert out[rid].finish_reason == FINISH_LENGTH
+        assert out[rid].latency is not None
+        assert out[rid].time_to_first_token is not None
+
+
+def test_serve_greedy_matches_generate_uniform(nano):
+    """Uniform-length prompts arriving together: one prefill batch, all
+    slots decode in lockstep — still token-identical to generate()."""
+    dec, params = nano
+    prompts = [[5, 17, 3, 9], [9, 2, 44, 1], [3, 3, 3, 3]]
+    n = 5
+    client = ServeClient(dec, params, num_slots=3, prefill_len=8)
+    for p in prompts:
+        client.submit(p, max_new_tokens=n)
+    out = client.run_until_idle()
+    ref = _ref_windows(dec, params, prompts, n)
+    for rid in range(3):
+        assert out[rid].tokens == ref[rid]
+
+
+def test_serve_multistep_matches_single_step(nano):
+    """steps_per_dispatch>1 (multi-step scheduling) is a pure dispatch
+    amortization: same trace, same greedy tokens as K=1 — including rows
+    finishing mid-block (eos) and slot reuse at K-token granularity."""
+    dec, params = nano
+    prompts = [[5, 17, 3, 9], [9, 2, 44], [42, 7], [1]]
+    n = 6
+    free = _ref_windows(dec, params, prompts, n)
+    eos = free[0][2]
+    trace = [(0, dict(prompt=prompts[0], max_new_tokens=n, eos_id=eos)),
+             (0, dict(prompt=prompts[1], max_new_tokens=n, eos_id=eos)),
+             (2, dict(prompt=prompts[2], max_new_tokens=n, eos_id=eos)),
+             (3, dict(prompt=prompts[3], max_new_tokens=n, eos_id=eos))]
+    multi = ServeClient(dec, params, num_slots=2, prefill_len=8,
+                        steps_per_dispatch=4)
+    out = multi.serve_trace(trace)
+    ref = _ref_windows(dec, params, prompts, n, eos_id=eos)
+    for rid in range(4):
+        assert out[rid].tokens == ref[rid], (rid, out[rid].tokens, ref)
+    assert out[0].tokens[-1] == eos and out[0].finish_reason == FINISH_EOS
+
+
+def test_serve_eos_mid_decode(nano):
+    """A row that samples eos mid-window retires mid-flight with
+    finish_reason='eos' and the truncated reference tokens."""
+    dec, params = nano
+    prompts = [[5, 17, 3, 9], [42, 7]]
+    n = 6
+    free = _ref_windows(dec, params, prompts, n)
+    eos = free[0][2]  # third emitted token of request 0
+    client = ServeClient(dec, params, num_slots=2, prefill_len=8)
+    for p in prompts:
+        client.submit(p, max_new_tokens=n, eos_id=eos)
+    out = client.run_until_idle()
+    ref = _ref_windows(dec, params, prompts, n, eos_id=eos)
+    for rid in range(2):
+        assert out[rid].tokens == ref[rid]
+        expect = FINISH_EOS if eos in ref[rid] else FINISH_LENGTH
+        assert out[rid].finish_reason == expect
+    assert out[0].tokens[-1] == eos and len(out[0].tokens) <= n
+
+
+def test_serve_edge_shapes(nano):
+    """The engine edge cases: P=1 prompt, B=1 engine (num_slots=1),
+    max_new_tokens=1 (retires at its own prefill), and eos on the very
+    first decoded token."""
+    dec, params = nano
+    # P=1 prompt through a B=1 engine, plus max_new_tokens=1
+    client = ServeClient(dec, params, num_slots=1, prefill_len=4)
+    r0 = client.submit([9], max_new_tokens=4)
+    r1 = client.submit([5, 17], max_new_tokens=1)
+    out = client.run_until_idle()
+    ref = _ref_windows(dec, params, [[9]], 4) \
+        + _ref_windows(dec, params, [[5, 17]], 1)
+    assert out[r0].tokens == ref[0]
+    assert out[r1].tokens == ref[1] and len(out[r1].tokens) == 1
+    assert out[r1].finish_reason == FINISH_LENGTH
+    # eos on the very first decoded token: finishes at prefill, reason eos
+    first = _ref_windows(dec, params, [[9]], 1)[0][0]
+    client2 = ServeClient(dec, params, num_slots=1, prefill_len=4)
+    r2 = client2.submit([9], max_new_tokens=4, eos_id=first)
+    out2 = client2.run_until_idle()
+    assert out2[r2].tokens == [first]
+    assert out2[r2].finish_reason == FINISH_EOS
+
+
+def test_slot_reuse_overwrites_stale_kv(nano):
+    """A freed slot's stale KV must never leak into its next tenant: a
+    SHORT prompt reusing the slot of a finished LONGER request (stale
+    K/V beyond the new row's positions) decodes exactly like a fresh
+    engine would."""
+    dec, params = nano
+    long_p, short_p = [5, 17, 3, 9, 2, 44, 1, 7], [42, 7]
+    n = 4
+    client = ServeClient(dec, params, num_slots=1, prefill_len=8)
+    out = client.serve_trace([
+        (0, dict(prompt=long_p, max_new_tokens=n)),
+        (1, dict(prompt=short_p, max_new_tokens=n)),  # queues, reuses slot
+    ])
+    fresh = ServeClient(dec, params, num_slots=1, prefill_len=8)
+    rid = fresh.submit(short_p, max_new_tokens=n)
+    assert out[1].tokens == fresh.run_until_idle()[rid].tokens
+    assert out[1].tokens == _ref_windows(dec, params, [short_p], n)[0]
+
+
+def test_sampling_reproducible_per_request(nano):
+    """temperature>0 streams are a pure function of (engine seed, request
+    seed, step): the same request replayed in a different arrival order /
+    batch composition samples the same tokens."""
+    dec, params = nano
+    kw = dict(max_new_tokens=5, temperature=0.8, top_k=12)
+    a = ServeClient(dec, params, num_slots=2, prefill_len=8, seed=3)
+    a.submit([5, 17, 3], seed=101, **kw)
+    a.submit([9, 2], seed=202, **kw)
+    out_a = a.run_until_idle()
+    b = ServeClient(dec, params, num_slots=2, prefill_len=8, seed=3)
+    # swapped arrival order, second request now joins mid-flight
+    b.submit([9, 2], seed=202, **kw)
+    out_b = b.serve_trace([(2, dict(prompt=[5, 17, 3], seed=101, **kw))])
+    tok_a = {202: out_a[1].tokens, 101: out_a[0].tokens}
+    tok_b = {202: out_b[0].tokens, 101: out_b[1].tokens}
+    assert tok_a == tok_b
+    assert all(0 <= t < 128 for toks in tok_a.values() for t in toks)
+
+
+def test_no_key_reuse_across_slots(nano):
+    """Two co-resident slots sharing a sampling seed would collide sample
+    streams — the pool refuses at acquire time."""
+    dec, params = nano
+    eng = ServeEngine(dec, params, num_slots=2, prefill_len=4)
+    reqs = [Request(id=0, prompt=[5], max_new_tokens=4, seed=7),
+            Request(id=1, prompt=[9], max_new_tokens=4, seed=7)]
+    with pytest.raises(ValueError, match="key reuse"):
+        eng.prefill(reqs)
+    # the reject is atomic: request 0's already-acquired slot was freed
+    assert eng.free_slots == 2 and eng.active_count == 0
+    # distinct seeds are fine, and the failed acquire left no leak
+    ok = [Request(id=2, prompt=[5], max_new_tokens=2, seed=7),
+          Request(id=3, prompt=[9], max_new_tokens=2, seed=8)]
+    eng2 = ServeEngine(dec, params, num_slots=2, prefill_len=4)
+    eng2.prefill(ok)
+    while eng2.active_count:
+        eng2.step()
+    assert eng2.free_slots == 2
+
+
+def test_seed_collision_defers_not_crashes(nano):
+    """Two requests with the SAME explicit seed must not take down the
+    serve loop: the client defers the second until the first retires
+    (they are never co-resident), and both complete with identical
+    streams — same seed, same prompt, same params."""
+    dec, params = nano
+    client = ServeClient(dec, params, num_slots=2, prefill_len=8)
+    kw = dict(max_new_tokens=4, temperature=0.9, top_k=16, seed=7)
+    r0 = client.submit([5, 17, 3], **kw)
+    r1 = client.submit([5, 17, 3], **kw)
+    out = client.run_until_idle()
+    assert out[r0].tokens == out[r1].tokens
+    assert out[r0].finish_reason == out[r1].finish_reason == FINISH_LENGTH
+    # deferral, not parallelism: the second request started only after
+    # the first finished
+    assert out[r1].first_token_time > out[r0].first_token_time
+
+
+def test_admission_control_and_deadlines(nano):
+    """QueueFull at max_queue_depth; a queued request whose deadline
+    passes while waiting times out with no tokens; an in-flight request
+    whose deadline passes mid-decode is cancelled with partial tokens."""
+    dec, params = nano
+    cfgs = SchedulerConfig(max_queue_depth=1)
+    client = ServeClient(dec, params, num_slots=1, prefill_len=4,
+                         scheduler_config=cfgs)
+    client.submit([5, 17], max_new_tokens=8)       # goes to the queue...
+    with pytest.raises(QueueFull):
+        client.submit([9], max_new_tokens=2)
+    with pytest.raises(ValueError, match="prefill_len"):
+        client.submit([1] * 9, max_new_tokens=2)   # can never fit
+    out = client.run_until_idle()
+    assert out[0].finish_reason == FINISH_LENGTH
+
+    # queued timeout: slot busy with a long decode, the waiter expires
+    client2 = ServeClient(dec, params, num_slots=1, prefill_len=4)
+    client2.submit([5, 17], max_new_tokens=12)
+    client2.submit([9], max_new_tokens=4, deadline=3.0)
+    out2 = client2.run_until_idle()
+    assert out2[1].finish_reason == FINISH_TIMEOUT
+    assert out2[1].tokens == []
+    assert out2[0].finish_reason == FINISH_LENGTH
+    assert len(out2[0].tokens) == 12
+
+    # mid-decode timeout: cancelled with the tokens produced so far
+    client3 = ServeClient(dec, params, num_slots=1, prefill_len=4)
+    client3.submit([5, 17], max_new_tokens=12, deadline=5.0)
+    out3 = client3.run_until_idle()
+    assert out3[0].finish_reason == FINISH_TIMEOUT
+    assert 0 < len(out3[0].tokens) < 12
+
+
+def test_trace_sheds_rejected_entries(nano):
+    """An overloaded trace replay sheds at admission (completion with
+    finish_reason='rejected') instead of aborting and discarding every
+    other request's work; trace-order request ids stay aligned."""
+    dec, params = nano
+    client = ServeClient(dec, params, num_slots=1, prefill_len=4,
+                         scheduler_config=SchedulerConfig(
+                             max_queue_depth=1))
+    out = client.serve_trace([
+        (0, dict(prompt=[5, 17], max_new_tokens=3)),  # prefilled at t=0
+        (1, dict(prompt=[9], max_new_tokens=3)),      # queued (depth 1)
+        (1, dict(prompt=[42], max_new_tokens=3)),     # shed: queue full
+        (1, dict(prompt=[1] * 9, max_new_tokens=3)),  # shed: never fits
+    ])
+    assert len(out) == 4
+    assert out[0].finish_reason == FINISH_LENGTH and len(out[0].tokens) == 3
+    assert out[1].finish_reason == FINISH_LENGTH and len(out[1].tokens) == 3
+    for rid in (2, 3):
+        assert out[rid].finish_reason == FINISH_REJECTED
+        assert out[rid].tokens == [] and out[rid].latency == 0
+
+
+def test_prefill_priority_policy():
+    """The interleaving knob, on a stub engine: priority 1.0 injects a
+    single waiter immediately; priority 0.0 keeps decoding until a full
+    prefill batch is queued (or the engine goes idle)."""
+    class Stub:
+        free_slots = 4
+        active_count = 3
+        prefill_batch = 4
+
+    eager = FifoScheduler(SchedulerConfig(prefill_priority=1.0))
+    eager.submit(Request(id=0, prompt=[1], max_new_tokens=2))
+    assert eager.next_action(Stub())[0] == ACTION_PREFILL
+
+    batchy = FifoScheduler(SchedulerConfig(prefill_priority=0.0))
+    for i in range(3):
+        batchy.submit(Request(id=i, prompt=[1], max_new_tokens=2))
+        assert batchy.next_action(Stub())[0] == ACTION_STEP
+    batchy.submit(Request(id=3, prompt=[1], max_new_tokens=2))
+    action, reqs = batchy.next_action(Stub())
+    assert action == ACTION_PREFILL and len(reqs) == 4
+    # an idle engine always prefills, whatever the priority
+    idle = Stub()
+    idle.active_count = 0
+    lazy = FifoScheduler(SchedulerConfig(prefill_priority=0.0))
+    lazy.submit(Request(id=9, prompt=[1], max_new_tokens=2))
+    assert lazy.next_action(idle)[0] == ACTION_PREFILL
