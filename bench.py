@@ -994,6 +994,123 @@ def _bench_serve(num_slots: int = 8, n_requests: int = 16,
     }
 
 
+def _bench_chaos(num_slots: int = 4, n_requests: int = 8,
+                 prompt: int = 32, new_tokens: int = 32,
+                 steps_per_dispatch: int = 4) -> dict:
+    """Serving under a pinned fault plan: throughput tax + recovery cost.
+
+    The same continuous-batching setup as ``_bench_serve`` (GPT-2-small,
+    bf16 serving params, greedy), driven twice over one deterministic
+    all-at-once burst: once clean, once with a PINNED
+    ``FaultPlan.random(seed=0)`` injecting 3 dispatch crashes that the
+    :class:`ServeSupervisor` must absorb (rebuild engine, replay every
+    in-flight prompt + emitted tokens, continue). Recovery must lose no
+    requests; token flips (possible here because bf16 + untrained
+    weights put greedy argmax margins below rounding — see the inline
+    note) are recorded as ``replay_token_mismatches``.
+
+    ``extras["chaos"]``: ``serve_tokens_per_sec`` under faults,
+    ``recovery_ms`` (mean wall per recovery: rebuild + replay prefills),
+    and ``chaos_slowdown`` vs the clean run. NOT in ``tracked_extras``
+    (no regression gate yet): recovery cost is dominated by engine
+    rebuild/compile behavior that varies across environments — recorded
+    for trend visibility first.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.reliability import FaultPlan, RetryPolicy
+    from ray_lightning_tpu.serve import FINISH_FAILED, ServeClient
+
+    total = prompt + new_tokens
+    base = dict(vocab_size=50304, max_seq_len=total, dtype=jnp.bfloat16,
+                scan_layers=False)
+    model = TransformerLM(gpt2_config("small", **base))
+    toks0 = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50257, size=(num_slots, prompt)), jnp.int32)
+    params = jax.device_put(jax.jit(
+        lambda r: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16),
+            model.init(r, toks0)["params"]))(jax.random.PRNGKey(0)))
+    dec = TransformerLM(gpt2_config("small", decode=True,
+                                    param_dtype=jnp.bfloat16, **base))
+
+    rng = np.random.default_rng(2)
+    trace = []
+    for _ in range(n_requests):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        trace.append((0.0, dict(
+            prompt=[int(t) for t in rng.integers(0, 50257, size=L)],
+            max_new_tokens=int(rng.integers(new_tokens // 2,
+                                            new_tokens + 1)))))
+
+    def run(plan=None):
+        # prefill_len covers prompt + full budget: the supervisor replays
+        # a request as prompt + emitted tokens through ONE prefill pass,
+        # so a window sized to prompts alone would shed mid-decode
+        # requests as unreplayable (the docs/reliability.md sizing rule)
+        client = ServeClient(
+            dec, params, num_slots=num_slots, prefill_len=total,
+            steps_per_dispatch=steps_per_dispatch,
+            clock=time.perf_counter,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay=0.0))
+        if plan is None:
+            out = client.serve_trace(trace)
+        else:
+            with plan.armed():
+                out = client.serve_trace(trace)
+        makespan = max(c.finish_time for c in out.values())
+        return client, out, makespan
+
+    run()  # warmup: compiles prefill+inject and the K-step program
+    _, base_out, base_makespan = run()
+
+    # ~3 crashes into a run of this size: horizon sized to land inside
+    # the burst's dispatch count at these knobs (seed 0 -> ticks 5/6/8)
+    plan = FaultPlan.random(0, 3, sites=("serve.dispatch",), horizon=10)
+    sup_client, out, makespan = run(plan)
+    sup = sup_client.engine  # the ServeSupervisor
+    if plan.fired < 3:
+        raise MeasurementError(
+            f"fault plan fired {plan.fired}/3 — horizon no longer "
+            "matches the dispatch count; retune _bench_chaos knobs")
+    # Replay token-identity is pinned EXACTLY in fp32 by
+    # tests/test_reliability.py. This bench runs bf16 with UNTRAINED
+    # random weights, where greedy top-1 margins over a 50k vocab sit
+    # below bf16 rounding — a replayed prefill's last-bit KV differences
+    # (batched matmul vs step-by-step accumulation order) can then flip
+    # a token. Record the flip count; fail only on the signals that mean
+    # recovery itself broke (failed requests / wholesale divergence).
+    mismatched = sum(1 for rid, comp in base_out.items()
+                     if out[rid].tokens != comp.tokens)
+    failed = sum(1 for c in out.values()
+                 if c.finish_reason == FINISH_FAILED)
+    if failed or mismatched > n_requests // 2:
+        raise MeasurementError(
+            f"recovery lost work ({failed} failed, {mismatched}/"
+            f"{n_requests} diverged) — replay is broken, timing numbers "
+            "would be meaningless")
+
+    tokens_total = sum(len(c.tokens) for c in out.values())
+    return {
+        "model": "gpt2_small (bf16 serving params)",
+        "num_slots": num_slots, "requests": n_requests,
+        "steps_per_dispatch": steps_per_dispatch,
+        "faults_injected": plan.fired,
+        "recoveries": sup.recoveries,
+        "engine_rebuilds": sup.rebuilds,
+        "replay_token_mismatches": mismatched,
+        "serve_tokens_per_sec": round(tokens_total / makespan, 0),
+        "faultfree_tokens_per_sec": round(
+            tokens_total / base_makespan, 0),
+        "chaos_slowdown": round(makespan / base_makespan, 2),
+        "recovery_ms": round(
+            1e3 * sup.recovery_s_total / max(1, sup.recoveries), 1),
+    }
+
+
 def _bench_flash_long_seq(T: int = 8192) -> dict:
     """Pallas flash vs XLA fused attention, train step (fwd+bwd) at long
     sequence — the regime the hand kernel exists for (XLA materializes the
@@ -1365,6 +1482,12 @@ def main() -> None:
         extras["serve"] = _bench_serve()
     except Exception as exc:
         extras["serve"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        # serving under a pinned fault plan: recovery cost, untracked
+        extras["chaos"] = _bench_chaos()
+    except Exception as exc:
+        extras["chaos"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     try:
         # batch scaling on the real chip: utilization growth small -> large

@@ -41,6 +41,10 @@ from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
                                     EMAWeightAveraging, ModelCheckpoint,
                                     EpochStatsCallback, seed_everything)
 from ray_lightning_tpu.launchers import RayLauncher, LocalLauncher
+from ray_lightning_tpu.reliability import (FaultPlan, FitSupervisor,
+                                           InjectedFault, NonFiniteError,
+                                           RetriesExhausted, RetryPolicy,
+                                           ServeSupervisor)
 
 __version__ = "0.2.0"
 
@@ -51,5 +55,7 @@ __all__ = [
     "TpuModule", "TpuDataModule",
     "Callback", "EarlyStopping", "EMAWeightAveraging", "ModelCheckpoint",
     "EpochStatsCallback", "seed_everything",
-    "RayLauncher", "LocalLauncher"
+    "RayLauncher", "LocalLauncher",
+    "FaultPlan", "FitSupervisor", "InjectedFault", "NonFiniteError",
+    "RetriesExhausted", "RetryPolicy", "ServeSupervisor",
 ]

@@ -252,5 +252,5 @@ class ShmRing:
     def __del__(self):  # best-effort; explicit destroy() preferred
         try:
             self.destroy()
-        except Exception:
-            pass
+        except Exception:  # tl-lint: allow-broad-except — __del__ may run
+            pass           # at interpreter teardown, when logging is gone

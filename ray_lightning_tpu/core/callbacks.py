@@ -108,7 +108,8 @@ class ModelCheckpoint(Callback):
                  save_top_k: int = 1,
                  save_last: bool = False,
                  save_format: str = "stream",
-                 async_save: bool = False):
+                 async_save: bool = False,
+                 every_n_train_steps: int = 0):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
         if save_format not in ("stream", "orbax"):
@@ -117,6 +118,10 @@ class ModelCheckpoint(Callback):
                 f"{save_format!r}")
         if async_save and save_format != "orbax":
             raise ValueError("async_save requires save_format='orbax'")
+        if every_n_train_steps < 0:
+            raise ValueError(
+                f"every_n_train_steps must be >= 0, got "
+                f"{every_n_train_steps}")
         self.dirpath = dirpath
         self.filename = filename
         self.monitor = monitor
@@ -125,11 +130,20 @@ class ModelCheckpoint(Callback):
         self.save_last = save_last
         self.save_format = save_format
         self.async_save = async_save
+        # periodic cadence for crash-safe resume: every N train batches,
+        # save an unmonitored mid-epoch checkpoint (the ckpt records its
+        # batch-in-epoch position, so resume="auto" fast-forwards the
+        # loader instead of replaying or skipping the half-epoch). 0 =
+        # epoch-end saves only.
+        self.every_n_train_steps = every_n_train_steps
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self.last_model_path: str = ""
         self._saved: list = []  # (score, path), worst-first
         self._last_saved_path: str = ""
+        # rolling crash-safety checkpoint (monitored configs only; see
+        # _save — unmonitored configs keep periodic saves in the ledger)
+        self._last_periodic_path: str = ""
 
     def setup(self, trainer, pl_module, stage: str) -> None:
         if self.dirpath is None:
@@ -142,8 +156,39 @@ class ModelCheckpoint(Callback):
         return (score < self.best_model_score if self.mode == "min" else
                 score > self.best_model_score)
 
+    def on_train_batch_end(self, trainer, pl_module, outputs, batch,
+                           batch_idx: int) -> None:
+        # periodic mid-epoch cadence: unmonitored (metrics may not exist
+        # yet), purely for crash-safe resume
+        if self.every_n_train_steps < 1 or \
+                trainer.global_step % self.every_n_train_steps:
+            return
+        self._save(trainer, monitor_val=None, periodic=True)
+
     def on_train_epoch_end(self, trainer, pl_module) -> None:
-        if self.save_top_k == 0:
+        self._save(trainer, monitor_val=self._monitor_value(trainer))
+
+    _SKIP = object()  # monitored metric absent: skip this save entirely
+
+    def _monitor_value(self, trainer):
+        if self.monitor is None:
+            return None
+        raw = trainer.callback_metrics.get(self.monitor)
+        if raw is None:
+            # PTL semantics: monitored metric absent this epoch (e.g.
+            # validation didn't run) ⇒ skip, never rank an unscored
+            # checkpoint against real scores.
+            if trainer.global_rank == 0:
+                import warnings
+                warnings.warn(
+                    f"ModelCheckpoint: monitored metric "
+                    f"{self.monitor!r} not found in callback_metrics; "
+                    "skipping checkpoint this epoch.")
+            return self._SKIP
+        return float(np.asarray(raw))
+
+    def _save(self, trainer, monitor_val, periodic: bool = False) -> None:
+        if self.save_top_k == 0 or monitor_val is self._SKIP:
             return
         # The orbax save is a *collective*: every jax.distributed process
         # must join (each writes its own non-addressable shards and all
@@ -156,21 +201,7 @@ class ModelCheckpoint(Callback):
             return
         name = self.filename.format(
             epoch=trainer.current_epoch, step=trainer.global_step)
-        monitor_val = None
-        if self.monitor is not None:
-            raw = trainer.callback_metrics.get(self.monitor)
-            if raw is None:
-                # PTL semantics: monitored metric absent this epoch (e.g.
-                # validation didn't run) ⇒ skip, never rank an unscored
-                # checkpoint against real scores.
-                if trainer.global_rank == 0:
-                    import warnings
-                    warnings.warn(
-                        f"ModelCheckpoint: monitored metric "
-                        f"{self.monitor!r} not found in callback_metrics; "
-                        "skipping checkpoint this epoch.")
-                return
-            monitor_val = float(np.asarray(raw))
+        if monitor_val is not None:
             name = f"{name}-{self.monitor}={monitor_val:.4f}"
         if trainer.global_rank == 0:
             os.makedirs(self.dirpath, exist_ok=True)
@@ -179,7 +210,10 @@ class ModelCheckpoint(Callback):
         trainer.save_checkpoint(path, save_format=self.save_format,
                                 async_save=self.async_save)
         self._last_saved_path = path
-        if self.save_last:
+        # 'last' tracks epoch-end saves only: rewriting it every periodic
+        # tick would double the cadence's checkpoint I/O for a copy the
+        # step-ordered resume scan never prefers over the periodic file
+        if self.save_last and not periodic:
             last_path = os.path.join(self.dirpath, "last" + suffix)
             trainer.save_checkpoint(last_path,
                                     save_format=self.save_format,
@@ -187,11 +221,31 @@ class ModelCheckpoint(Callback):
         if trainer.global_rank != 0:
             return
         # bookkeeping + pruning stay rank-0-only
+        if periodic and self.monitor is not None:
+            # a monitored checkpoint ledger scores in metric units; an
+            # unmonitored crash-safety save must NOT compete there (a
+            # recency score of -global_step would beat every real
+            # mode='min' metric and hijack best_model_path / top-k).
+            # Periodic saves instead roll: keep only the newest one.
+            prev = self._last_periodic_path
+            if prev and prev != path and os.path.exists(prev) and \
+                    prev != self.best_model_path and \
+                    all(prev != p for _s, p in self._saved):
+                if os.path.isdir(prev):
+                    import shutil
+                    shutil.rmtree(prev, ignore_errors=True)
+                else:
+                    os.remove(prev)
+            self._last_periodic_path = path
+            return
         score = monitor_val if monitor_val is not None else \
             -float(trainer.global_step)  # no monitor: newest is best
         if self._is_better(score):
             self.best_model_score = score
             self.best_model_path = path
+        # a periodic save and an epoch-end save can land on the same
+        # step= path: keep one ledger entry per file on disk
+        self._saved = [(s, p) for s, p in self._saved if p != path]
         self._saved.append((score, path))
         self._prune()
         if self.save_last:
@@ -343,6 +397,7 @@ class EpochStatsCallback(Callback):
         self.epoch_times: list = []
         self.peak_memory_mib: list = []
         self._t0 = 0.0
+        self._stats_unavailable_logged = False
 
     def on_train_epoch_start(self, trainer, pl_module) -> None:
         self._t0 = time.perf_counter()
@@ -357,8 +412,17 @@ class EpochStatsCallback(Callback):
                 stats = d.memory_stats()
                 if stats and "peak_bytes_in_use" in stats:
                     peaks.append(stats["peak_bytes_in_use"] / 2**20)
-            except Exception:  # noqa: BLE001 - cpu backend has no stats
-                pass
+            except Exception as exc:  # noqa: BLE001 - cpu has no stats
+                # expected on the CPU backend: note it ONCE per run, not
+                # per device per epoch — the suppressed-exception channel
+                # must stay readable for real failures
+                if not self._stats_unavailable_logged:
+                    from ray_lightning_tpu.reliability import \
+                        log_suppressed
+                    log_suppressed("callbacks.memory_stats", exc,
+                                   f"device {d} exposes no memory stats"
+                                   " (expected on CPU); reported once")
+                    self._stats_unavailable_logged = True
         peak = float(np.mean(peaks)) if peaks else 0.0
         self.peak_memory_mib.append(peak)
         if self.print_stats and trainer.global_rank == 0:

@@ -16,22 +16,70 @@ formats:
 Both produce the same logical dict (``state`` / ``epoch`` / ``global_step``
 / ``callbacks`` / ``module``), so ``Trainer.fit(ckpt_path=…)`` accepts
 either — a file is a stream, a directory is orbax.
+
+Crash-safety contract (docs/reliability.md):
+
+- Directory checkpoints are *committed*, never half-visible: orbax items
+  commit atomically on their own (tmp dir + rename inside orbax), and the
+  **numpy fallback** (used when orbax is absent, or forced with
+  ``backend="numpy"``) stages everything in a ``<dir>.tmp-<pid>`` sibling
+  and ``os.replace()``\\ s it into place — a process killed mid-save
+  leaves only a tmp dir that resume scans ignore.
+- ``tl_meta.msgpack`` is the commit marker, written *last*: a directory
+  missing it (or its state item) is an interrupted save, and
+  :func:`load_sharded_checkpoint` raises :class:`CorruptCheckpointError`
+  with the reason instead of a bare numpy/orbax error.
+  ``Trainer(resume="auto")`` catches that, skips the corpse, and falls
+  back to the previous candidate (:func:`find_resume_candidates`).
+- The ``ckpt.save`` fault site fires at the pre-commit point of every
+  writer, so tests kill saves deterministically mid-flight.
 """
 from __future__ import annotations
 
+import atexit
 import os
-from typing import Any, Dict, Optional
+import shutil
+from typing import Any, Dict, List, Optional
 
 import jax
 from flax import serialization
 
+from ray_lightning_tpu.reliability import faults, log_suppressed
+
 _META_NAME = "tl_meta.msgpack"
 _STATE_NAME = "state"
 _CB_NAME = "cb_arrays"
+_NP_STATE_NAME = "np_state.msgpack"
+_TMP_MARK = ".tmp-"
 
 # process-wide async checkpointer: orbax requires one long-lived instance
 # (it owns the background commit thread + multihost barrier ids)
 _ASYNC_CKPTR = None
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint directory/file is incomplete or unreadable — the
+    saving process likely died before its commit finished. Auto-resume
+    skips such candidates; manual loads should pick an older one."""
+
+
+_HAVE_ORBAX: Optional[bool] = None
+
+
+def have_orbax() -> bool:
+    # probed once per process: a failed import is NOT cached by Python
+    # (sys.path is rescanned every attempt), and the save path may run
+    # every N batches — pay the probe and the log line a single time
+    global _HAVE_ORBAX
+    if _HAVE_ORBAX is None:
+        try:
+            import orbax.checkpoint  # noqa: F401
+            _HAVE_ORBAX = True
+        except Exception as exc:  # noqa: BLE001 — fallback records why
+            log_suppressed("ckpt.backend", exc,
+                           "orbax unavailable; using the numpy fallback")
+            _HAVE_ORBAX = False
+    return _HAVE_ORBAX
 
 
 def _async_checkpointer():
@@ -39,6 +87,10 @@ def _async_checkpointer():
     if _ASYNC_CKPTR is None:
         import orbax.checkpoint as ocp
         _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        # a run that exits right after its last async save must not lose
+        # the tail commit: drain at interpreter exit (the trainer also
+        # drains at fit teardown — this covers bare-script users)
+        atexit.register(wait_for_async_saves)
     return _ASYNC_CKPTR
 
 
@@ -46,8 +98,9 @@ def wait_for_async_saves() -> None:
     """Block until every in-flight async checkpoint commit finishes.
 
     No-op when no async save was ever issued. The trainer calls this at
-    fit end (and before reading a checkpoint) so a process never exits —
-    or restores — with a half-committed directory.
+    fit end (and before reading a checkpoint), and it is registered via
+    ``atexit`` when the first async save is issued, so a process never
+    exits — or restores — with a half-committed directory.
     """
     if _ASYNC_CKPTR is not None:
         _ASYNC_CKPTR.wait_until_finished()
@@ -55,7 +108,8 @@ def wait_for_async_saves() -> None:
 
 def save_sharded_checkpoint(dirpath: str, ckpt: Dict[str, Any],
                             train_state: Any,
-                            async_save: bool = False) -> None:
+                            async_save: bool = False,
+                            backend: Optional[str] = None) -> None:
     """Write ``ckpt`` (minus the state) + the *sharded* train state.
 
     ``train_state`` leaves stay ``jax.Array``s — orbax writes each shard
@@ -65,7 +119,19 @@ def save_sharded_checkpoint(dirpath: str, ckpt: Dict[str, Any],
     ``async_save=True`` returns as soon as the device→host copy is done;
     the disk write commits on a background thread (training overlaps the
     I/O). Call :func:`wait_for_async_saves` before relying on the files.
+
+    ``backend``: ``"orbax"`` | ``"numpy"`` | ``None`` (auto: orbax when
+    importable). The numpy fallback host-gathers (single-process states
+    only), stages into a tmp sibling and commits with ``os.replace`` —
+    crash-safe, synchronous, dependency-free.
     """
+    backend = backend or ("orbax" if have_orbax() else "numpy")
+    if backend == "numpy":
+        _save_numpy_checkpoint(dirpath, ckpt, train_state, async_save)
+        return
+    if backend != "orbax":
+        raise ValueError(
+            f"backend must be 'orbax', 'numpy' or None, got {backend!r}")
     import orbax.checkpoint as ocp
 
     dirpath = os.path.abspath(dirpath)
@@ -93,10 +159,72 @@ def save_sharded_checkpoint(dirpath: str, ckpt: Dict[str, Any],
                        serialization.to_state_dict(cb_arrays), force=True)
         ckptr.wait_until_finished()
 
+    # the meta file is the COMMIT MARKER (written last; a directory
+    # without it reads as an interrupted save) — the ckpt.save fault
+    # fires just before it, so chaos tests produce exactly the torn
+    # state a mid-save kill leaves behind
+    faults.fire("ckpt.save")
     meta = {k: v for k, v in ckpt.items()
             if k not in ("state", "callback_arrays")}
     with open(os.path.join(dirpath, _META_NAME), "wb") as f:
         f.write(serialization.msgpack_serialize(meta))
+
+
+def _save_numpy_checkpoint(dirpath: str, ckpt: Dict[str, Any],
+                           train_state: Any, async_save: bool) -> None:
+    """Orbax-free directory checkpoint: host numpy via flax msgpack.
+
+    Everything is staged in ``<dirpath>.tmp-<pid>`` and committed with a
+    single ``os.replace`` — readers either see the complete old
+    checkpoint or the complete new one, never a torn write. Host-gathers
+    the state (``device_get``), so it is for single-process /
+    fully-addressable states; multi-host sharded states need orbax.
+    """
+    if async_save:
+        raise ValueError(
+            "async_save requires orbax (the numpy fallback is a "
+            "synchronous host write)")
+    dirpath = os.path.abspath(dirpath)
+    parent = os.path.dirname(dirpath)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = f"{dirpath}{_TMP_MARK}{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    try:
+        payload = {"state": jax.device_get(
+            serialization.to_state_dict(ckpt.get("state", train_state)))}
+        cb_arrays = ckpt.get("callback_arrays") or None
+        if cb_arrays:
+            payload["callback_arrays"] = jax.device_get(
+                serialization.to_state_dict(cb_arrays))
+        with open(os.path.join(tmp, _NP_STATE_NAME), "wb") as f:
+            f.write(serialization.msgpack_serialize(payload))
+        meta = {k: v for k, v in ckpt.items()
+                if k not in ("state", "callback_arrays")}
+        with open(os.path.join(tmp, _META_NAME), "wb") as f:
+            f.write(serialization.msgpack_serialize(meta))
+        # pre-commit point: a raise here = the process died mid-save;
+        # only the tmp staging dir (ignored by resume scans) remains
+        faults.fire("ckpt.save")
+        # Overwrite without a destroy-before-commit window: os.replace
+        # cannot atomically replace a non-empty directory, so the old
+        # checkpoint is renamed ASIDE (atomic) rather than rmtree'd
+        # before the new one lands. A kill between the two renames
+        # leaves the aside dir — still a complete, loadable checkpoint
+        # that resume scans DO consider (only ".tmp-" staging is
+        # ignored) — so at every instant at least one committed copy of
+        # this checkpoint exists on disk.
+        aside = None
+        if os.path.isdir(dirpath):
+            aside = f"{dirpath}.prev-{os.getpid()}"
+            shutil.rmtree(aside, ignore_errors=True)
+            os.replace(dirpath, aside)
+        os.replace(tmp, dirpath)
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def load_sharded_checkpoint(dirpath: str,
@@ -106,23 +234,71 @@ def load_sharded_checkpoint(dirpath: str,
     Without ``target`` the state comes back as host numpy (then re-placed
     by the trainer's sharding rules — resize-friendly). With a ``target``
     pytree of ``jax.ShapeDtypeStruct`` + shardings, orbax restores straight
-    into the sharded layout with no host round-trip.
+    into the sharded layout with no host round-trip (orbax format only).
+
+    Raises :class:`CorruptCheckpointError` for truncated/partial
+    directories — missing state item, missing ``tl_meta.msgpack`` commit
+    marker, or undecodable contents — instead of a bare numpy/orbax
+    error, so resume logic can skip to an older candidate.
     """
+    dirpath = os.path.abspath(dirpath)
+    np_path = os.path.join(dirpath, _NP_STATE_NAME)
+    state_path = os.path.join(dirpath, _STATE_NAME)
+    meta_path = os.path.join(dirpath, _META_NAME)
+    if not os.path.exists(meta_path):
+        # the meta is written last: its absence means the save never
+        # committed (e.g. an async commit interrupted by OOM/preemption)
+        raise CorruptCheckpointError(
+            f"{dirpath} has no '{_META_NAME}' commit marker — the save "
+            "was interrupted before it finished. Pick an older "
+            "checkpoint.")
+    if os.path.exists(np_path):
+        out = _load_numpy_checkpoint(dirpath, np_path, meta_path)
+        if target is not None:
+            out["state"] = serialization.from_state_dict(target,
+                                                         out["state"])
+        return out
+    if not os.path.isdir(state_path):
+        raise CorruptCheckpointError(
+            f"{dirpath} has no committed '{_STATE_NAME}' item — the "
+            "checkpoint is incomplete (the saving process likely died "
+            "before its orbax commit finished). Pick an older "
+            "checkpoint.")
+    return _load_orbax_checkpoint(dirpath, state_path, meta_path, target)
+
+
+def _read_meta(meta_path: str) -> Dict[str, Any]:
+    try:
+        with open(meta_path, "rb") as f:
+            return serialization.msgpack_restore(f.read())
+    except Exception as exc:
+        raise CorruptCheckpointError(
+            f"unreadable checkpoint meta {meta_path}: "
+            f"{type(exc).__name__}: {exc}") from exc
+
+
+def _load_numpy_checkpoint(dirpath: str, np_path: str,
+                           meta_path: str) -> Dict[str, Any]:
+    try:
+        with open(np_path, "rb") as f:
+            payload = serialization.msgpack_restore(f.read())
+    except Exception as exc:
+        raise CorruptCheckpointError(
+            f"unreadable numpy checkpoint {dirpath}: "
+            f"{type(exc).__name__}: {exc}") from exc
+    out = dict(_read_meta(meta_path))
+    out["state"] = payload.get("state")
+    if payload.get("callback_arrays") is not None:
+        out["callback_arrays"] = payload["callback_arrays"]
+    return out
+
+
+def _load_orbax_checkpoint(dirpath: str, state_path: str, meta_path: str,
+                           target: Optional[Any]) -> Dict[str, Any]:
     import numpy as np
     import orbax.checkpoint as ocp
 
-    dirpath = os.path.abspath(dirpath)
     ckptr = ocp.StandardCheckpointer()
-    state_path = os.path.join(dirpath, _STATE_NAME)
-    if not os.path.isdir(state_path):
-        # orbax commits the item atomically (tmp dir + rename), so a
-        # missing 'state' item means the save never finished — e.g. an
-        # async commit interrupted by OOM/preemption. The meta file alone
-        # does not make a checkpoint.
-        raise FileNotFoundError(
-            f"{dirpath} has no committed '{_STATE_NAME}' item — the "
-            "checkpoint is incomplete (the saving process likely died "
-            "before its orbax commit finished). Pick an older checkpoint.")
 
     def _restore_numpy(path):
         # Restore to host numpy EXPLICITLY: a bare restore replays the
@@ -136,22 +312,62 @@ def load_sharded_checkpoint(dirpath: str,
         return ocp.PyTreeCheckpointer().restore(path,
                                                 restore_args=restore_args)
 
-    if target is not None:
-        state = ckptr.restore(state_path, target)
-    else:
-        state = _restore_numpy(state_path)
-    meta_path = os.path.join(dirpath, _META_NAME)
-    meta: Dict[str, Any] = {}
-    if os.path.exists(meta_path):
-        with open(meta_path, "rb") as f:
-            meta = serialization.msgpack_restore(f.read())
-    out = dict(meta)
+    try:
+        if target is not None:
+            state = ckptr.restore(state_path, target)
+        else:
+            state = _restore_numpy(state_path)
+    except Exception as exc:
+        raise CorruptCheckpointError(
+            f"failed to restore orbax state from {dirpath}: "
+            f"{type(exc).__name__}: {exc}") from exc
+    out = dict(_read_meta(meta_path))
     out["state"] = state
     cb_path = os.path.join(dirpath, _CB_NAME)
     if os.path.isdir(cb_path):
-        out["callback_arrays"] = _restore_numpy(cb_path)
+        try:
+            out["callback_arrays"] = _restore_numpy(cb_path)
+        except Exception as exc:
+            raise CorruptCheckpointError(
+                f"failed to restore callback arrays from {dirpath}: "
+                f"{type(exc).__name__}: {exc}") from exc
     return out
 
 
 def is_sharded_checkpoint(path: str) -> bool:
     return os.path.isdir(path)
+
+
+def _step_of(path: str) -> int:
+    """Parse the ``step=N`` our ModelCheckpoint naming embeds, else -1."""
+    name = os.path.basename(path)
+    for part in name.replace(".ckpt", "").replace(".orbax", "").split("-"):
+        if part.startswith("step="):
+            try:
+                return int(part[len("step="):])
+            except ValueError:
+                return -1
+    return -1
+
+
+def find_resume_candidates(root: str) -> List[str]:
+    """Checkpoint candidates under ``root``, best-first.
+
+    Ordered by the ``step=N`` embedded in our checkpoint filenames
+    (newest training progress first), falling back to mtime for foreign
+    names. Staging dirs (``*.tmp-*``) are never candidates. The caller
+    (``resume="auto"``) tries each in turn and skips the ones that raise
+    :class:`CorruptCheckpointError`.
+    """
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in sorted(os.listdir(root)):
+        if _TMP_MARK in name:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isdir(path) or name.endswith(".ckpt"):
+            out.append(path)
+    out.sort(key=lambda p: (_step_of(p), os.path.getmtime(p), p),
+             reverse=True)
+    return out

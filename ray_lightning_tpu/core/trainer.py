@@ -31,6 +31,8 @@ from flax import serialization
 
 from ray_lightning_tpu import util as _util
 from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
+from ray_lightning_tpu.reliability import faults as _faults
+from ray_lightning_tpu.reliability import log_suppressed
 from ray_lightning_tpu.parallel import sharding as shardlib
 from ray_lightning_tpu.core.module import TpuDataModule, TpuModule
 from ray_lightning_tpu.core.seed import seed_everything
@@ -73,7 +75,9 @@ class Trainer:
                  accumulate_grad_batches: int = 1,
                  track_grad_norm: bool = False,
                  profiler=None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 resume: Optional[str] = None,
+                 nonfinite_action: Optional[str] = None):
         from ray_lightning_tpu.strategies.ddp import RayStrategy
         self.strategy = strategy if strategy is not None else RayStrategy(
             num_workers=1)
@@ -109,6 +113,30 @@ class Trainer:
         from ray_lightning_tpu.core.profiler import resolve_profiler
         self.profiler = resolve_profiler(profiler)
         self.seed = seed_everything(seed) if seed is not None else None
+        # crash-safe resume: resume="auto" makes fit() (when called
+        # without an explicit ckpt_path) scan the checkpoint dir, restore
+        # the newest VALID checkpoint (corrupt/partial candidates are
+        # skipped with a logged warning) and continue at the saved step —
+        # mid-epoch checkpoints fast-forward the dataloader to the saved
+        # batch. See docs/reliability.md.
+        if resume not in (None, "auto"):
+            raise ValueError(
+                f"resume must be None or 'auto', got {resume!r}")
+        self.resume = resume
+        # NaN/Inf guard over loss AND gradients (checked element-exact
+        # inside the compiled step): None = off (no per-step host sync),
+        # "raise" = fail fast, "skip_batch" = drop the poisoned update
+        # (device-side select, weights never touched), or
+        # "restore_last_ckpt" = roll weights/optimizer back to the last
+        # saved checkpoint and keep training.
+        if nonfinite_action not in (None, "raise", "skip_batch",
+                                    "restore_last_ckpt"):
+            raise ValueError(
+                "nonfinite_action must be None, 'raise', 'skip_batch' or "
+                f"'restore_last_ckpt', got {nonfinite_action!r}")
+        self.nonfinite_action = nonfinite_action
+        self.nonfinite_batches = 0   # guarded steps that came back bad
+        self.nonfinite_restores = 0  # times restore_last_ckpt fired
 
         if self.enable_checkpointing and not any(
                 isinstance(cb, ModelCheckpoint) for cb in self.callbacks):
@@ -129,6 +157,11 @@ class Trainer:
         self._model = None
         self._launcher = None
         self._last_logs: Dict[str, Any] = {}
+        self._last_ckpt_path: str = ""   # newest save_checkpoint target
+        # batches completed in the CURRENT epoch (-1 = epoch boundary):
+        # checkpointed so resume="auto" can fast-forward a mid-epoch save
+        self._batch_in_epoch: int = -1
+        self._resume_skip: int = 0
 
     # ------------------------------------------------------------------ #
     # properties
@@ -170,6 +203,8 @@ class Trainer:
     def fit(self, module: TpuModule,
             datamodule: Optional[TpuDataModule] = None,
             ckpt_path: Optional[str] = None) -> None:
+        if ckpt_path is None and self.resume is not None:
+            ckpt_path = self.resume  # "auto": scan-and-restore in worker
         self.state = "fitting"
         if self._launcher is None:
             self._launcher = self.strategy.configure_launcher()
@@ -364,7 +399,8 @@ class Trainer:
 
         train_step = strategy.make_train_step(
             loss_fn, tx, state_shardings, batch_sharding,
-            log_grad_norm=self.track_grad_norm)
+            log_grad_norm=self.track_grad_norm,
+            guard_nonfinite=self.nonfinite_action is not None)
         val_step = strategy.make_eval_step(
             eval_fn_builder("validation_step"), state_shardings,
             batch_sharding)
@@ -401,14 +437,28 @@ class Trainer:
 
         sample_batch, train_loader = self._peek_first_batch(train_loader)
         restored_ckpt = None
-        if ckpt_path is not None:
+        if ckpt_path == "auto":
+            ckpt_path, restored_ckpt = self._resolve_auto_resume()
+        elif ckpt_path is not None:
             restored_ckpt = self._read_checkpoint(ckpt_path)
         state = self._setup_state(
             sample_batch,
             restored_ckpt["state"] if restored_ckpt else None)
         start_epoch = 0
+        self._resume_skip = 0
         if restored_ckpt is not None:
-            start_epoch = int(restored_ckpt.get("epoch", -1)) + 1
+            saved_epoch = int(restored_ckpt.get("epoch", -1))
+            # mid-epoch checkpoints (periodic every_n_train_steps saves)
+            # record how many batches of `saved_epoch` were done; resume
+            # re-enters that epoch and fast-forwards the loader. -1 (or a
+            # pre-knob checkpoint) = saved at the epoch boundary.
+            bie = int((restored_ckpt.get("loop") or {})
+                      .get("batch_in_epoch", -1))
+            if bie < 0:
+                start_epoch = saved_epoch + 1
+            else:
+                start_epoch = max(0, saved_epoch)
+                self._resume_skip = bie
             self.global_step = int(restored_ckpt.get("global_step", 0))
             for cb in self.callbacks:
                 cb_state = restored_ckpt.get("callbacks", {}).get(
@@ -475,11 +525,28 @@ class Trainer:
                                            * n_batches))
                 else:
                     val_every = int(self.val_check_interval)
+            # resume fast-forward: a mid-epoch checkpoint recorded how
+            # many batches of this epoch it had completed; skip exactly
+            # those (the loader is deterministic per epoch via set_epoch,
+            # so the replayed tail matches the uninterrupted run)
+            skip = self._resume_skip if epoch == start_epoch else 0
+            self._batch_in_epoch = skip
+            feed = train_loader
+            if skip:
+                import itertools
+                feed = itertools.islice(iter(train_loader), skip, None)
             t0 = time.perf_counter()
             for batch_idx, batch in enumerate(
                     self.profiler.profile_iterable(
-                        self._prefetch(train_loader, n_batches),
-                        "get_train_batch")):
+                        self._prefetch(feed, max(0, n_batches - skip)),
+                        "get_train_batch"), start=skip):
+                mode = _faults.fire("train.step")
+                if mode == _faults.MODE_NAN:
+                    from ray_lightning_tpu.reliability.guard import \
+                        poison_nan
+                    batch = shardlib.put_global_batch(
+                        poison_nan(jax.device_get(batch)),
+                        self._batch_sharding)
                 module.on_train_batch_start(batch, batch_idx)
                 for cb in self.callbacks:
                     cb.on_train_batch_start(self, module, batch, batch_idx)
@@ -488,8 +555,13 @@ class Trainer:
                     cb.on_before_optimizer_step(self, module, self._tx)
                 with self.profiler.profile("train_step"):
                     state, logs = self._train_step(state, batch)
+                if self.nonfinite_action is not None and \
+                        bool(np.asarray(jax.device_get(
+                            logs["nonfinite"]))):
+                    state = self._handle_nonfinite(state)
                 self.train_state = state
                 self.global_step += 1
+                self._batch_in_epoch = batch_idx + 1
                 epoch_logs.append(logs)
                 self._last_logs = logs
                 module.on_train_batch_end(logs, batch, batch_idx)
@@ -510,6 +582,11 @@ class Trainer:
                     break
                 if self.should_stop:  # PTL parity: honored mid-epoch too
                     break
+
+            # the epoch's batch loop is over: checkpoints taken from here
+            # on (epoch-end ModelCheckpoint saves) resume at the NEXT
+            # epoch, not inside this one
+            self._batch_in_epoch = -1
 
             # epoch aggregation: one host sync per epoch, not per step
             agg = self._aggregate_epoch_logs(epoch_logs, prefix="train_")
@@ -562,6 +639,75 @@ class Trainer:
         if self.strategy.global_rank == 0:
             self.profiler.describe()
         return self._collect_rank_zero_results()
+
+    def _handle_nonfinite(self, state):
+        """Apply ``nonfinite_action`` to a step whose loss/grads went
+        NaN/Inf. The compiled step already kept the pre-step weights
+        (device-side select), so ``skip_batch`` only has to account for
+        it; ``restore_last_ckpt`` additionally rolls the train state back
+        to the newest checkpoint this run saved."""
+        from ray_lightning_tpu.reliability.guard import NonFiniteError
+        self.nonfinite_batches += 1
+        where = (f"global step {self.global_step} "
+                 f"(epoch {self.current_epoch})")
+        if self.nonfinite_action == "raise":
+            raise NonFiniteError(
+                f"non-finite loss/gradients at {where}; use "
+                "nonfinite_action='skip_batch' or 'restore_last_ckpt' "
+                "to continue past poisoned batches instead")
+        if self.nonfinite_action == "skip_batch":
+            log_suppressed("train.step",
+                           NonFiniteError(f"non-finite update at {where}"),
+                           "update skipped, weights untouched")
+            return state
+        # restore_last_ckpt
+        path = self._last_ckpt_path
+        if path and not os.path.exists(path):
+            # the recorded path can be pruned out from under us (top-k
+            # kept better checkpoints): fall back to the newest valid
+            # candidate in the same directory instead of crashing
+            from ray_lightning_tpu.core.checkpoint import \
+                find_resume_candidates
+            candidates = find_resume_candidates(os.path.dirname(path))
+            path = candidates[0] if candidates else ""
+        if not path:
+            raise NonFiniteError(
+                f"non-finite loss/gradients at {where} and "
+                "nonfinite_action='restore_last_ckpt', but no checkpoint "
+                "is available — enable checkpointing (e.g. "
+                "ModelCheckpoint(every_n_train_steps=...)) or use "
+                "'skip_batch'")
+        restored = self._read_checkpoint(path)
+        host = serialization.from_state_dict(
+            jax.device_get(state), restored["state"])
+        self.nonfinite_restores += 1
+        log_suppressed("train.step",
+                       NonFiniteError(f"non-finite update at {where}"),
+                       f"state rolled back to {path}")
+        return jax.device_put(host, self._state_shardings)
+
+    def _resolve_auto_resume(self):
+        """``resume="auto"``: newest *valid* checkpoint in the run's
+        checkpoint dir, or ``(None, None)`` for a fresh start.
+
+        Only corruption-class errors (``CorruptCheckpointError``, I/O and
+        decode failures) skip to an older candidate — a programming error
+        (e.g. a callback's ``on_load_checkpoint`` raising) propagates
+        instead of silently restarting training from scratch."""
+        from ray_lightning_tpu.core.checkpoint import (
+            CorruptCheckpointError, find_resume_candidates)
+        ckpt_cb = self.checkpoint_callback
+        root = ckpt_cb.dirpath if ckpt_cb is not None and ckpt_cb.dirpath \
+            else os.path.join(self.default_root_dir, "checkpoints")
+        for path in find_resume_candidates(root):
+            try:
+                return path, self._read_checkpoint(path)
+            except (CorruptCheckpointError, OSError, EOFError,
+                    ValueError) as exc:
+                log_suppressed(
+                    "ckpt.load", exc,
+                    f"resume='auto' skipping corrupt candidate {path}")
+        return None, None
 
     def _run_validation(self, val_loader, module, limit=None):
         module.on_validation_epoch_start()
@@ -642,6 +788,10 @@ class Trainer:
         for batch in loader:
             if count >= n_batches:
                 break
+            mode = _faults.fire("loader.next")
+            if mode == _faults.MODE_NAN:
+                from ray_lightning_tpu.reliability.guard import poison_nan
+                batch = poison_nan(batch)
             buf.append(shardlib.put_global_batch(
                 self._cast_batch(batch), self._batch_sharding))
             count += 1
@@ -679,7 +829,12 @@ class Trainer:
         loader = self._dataloader(loader_name)
         if loader is None:
             raise ValueError(f"No {loader_name} defined for {stage}")
-        restored = self._read_checkpoint(ckpt_path) if ckpt_path else None
+        if ckpt_path == "auto":
+            _path, restored = self._resolve_auto_resume()
+        elif ckpt_path:
+            restored = self._read_checkpoint(ckpt_path)
+        else:
+            restored = None
         restored_state = restored["state"] if restored else None
         if restored_state is None and self.train_state is None:
             # weights recovered from a remote fit without a local template
@@ -884,11 +1039,22 @@ class Trainer:
             ckpt = self.dump_checkpoint(consolidate=False)
             save_sharded_checkpoint(filepath, ckpt, self.train_state,
                                     async_save=async_save)
+            self._last_ckpt_path = filepath
             return
         ckpt = self.dump_checkpoint()
         os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
-        with open(filepath, "wb") as f:
-            f.write(_util.to_state_stream(ckpt))
+        tmp = f"{filepath}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(_util.to_state_stream(ckpt))
+            # pre-commit fault seat + atomic publish: a crash mid-write
+            # leaves only the tmp file, which resume scans ignore
+            _faults.fire("ckpt.save")
+            os.replace(tmp, filepath)
+        finally:
+            if os.path.exists(tmp):  # failed before the rename: no litter
+                os.remove(tmp)
+        self._last_ckpt_path = filepath
 
     def dump_checkpoint(self, consolidate: bool = True) -> Dict[str, Any]:
         module_state: Dict[str, Any] = {}
@@ -897,6 +1063,10 @@ class Trainer:
         ckpt = {
             "epoch": self.current_epoch,
             "global_step": self.global_step,
+            # loop position inside the current epoch (-1 = boundary):
+            # lets resume="auto" fast-forward the dataloader instead of
+            # skipping the rest of a half-trained epoch
+            "loop": {"batch_in_epoch": int(self._batch_in_epoch)},
             "state": serialization.to_state_dict(
                 jax.device_get(self._consolidated_state()) if consolidate
                 else self.train_state),

@@ -113,8 +113,10 @@ def _producer(loader, worker_id: int, num_workers: int, ring_name: str,
             ring.push_buffers(
                 _pack_frames(("error", repr(e), traceback.format_exc())),
                 timeout=5.0)
-        except Exception:
-            pass
+        except Exception as push_exc:
+            from ray_lightning_tpu.reliability import log_suppressed
+            log_suppressed("multiproc.error_report", push_exc,
+                           "could not ship the worker error over the ring")
         raise
     finally:
         ring.close()

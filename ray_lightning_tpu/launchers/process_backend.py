@@ -65,7 +65,11 @@ def _worker_main(conn, env: Dict[str, str]) -> None:
         except BaseException as exc:  # noqa: BLE001 - must cross the pipe
             try:
                 payload = pickle.dumps(exc)
-            except Exception:
+            except Exception as pickle_exc:
+                from ray_lightning_tpu.reliability import log_suppressed
+                log_suppressed("process_backend.pickle", pickle_exc,
+                               "unpicklable worker exception; shipping "
+                               "the traceback as RuntimeError instead")
                 payload = pickle.dumps(
                     RuntimeError(traceback.format_exc()))
             try:

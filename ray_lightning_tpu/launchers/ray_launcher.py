@@ -107,8 +107,10 @@ class ExecutorBase:
             import ray
             acc = ray.get_runtime_context().get_accelerator_ids()
             ids = [int(i) for i in acc.get("TPU", [])]
-        except Exception:
-            pass
+        except Exception as exc:
+            from ray_lightning_tpu.reliability import log_suppressed
+            log_suppressed("ray_launcher.accelerator_ids", exc,
+                           "falling back to env/devfs chip discovery")
         if not ids:
             env = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
             if env:
@@ -302,7 +304,10 @@ class RayLauncher:
             return
         try:
             nodes = nodes_fn() or []
-        except Exception:
+        except Exception as exc:
+            from ray_lightning_tpu.reliability import log_suppressed
+            log_suppressed("ray_launcher.node_table", exc,
+                           "no node table; skipping capacity preflight")
             return
         if not nodes:
             return  # degenerate/partial node table — nothing to conclude

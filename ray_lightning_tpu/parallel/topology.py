@@ -172,7 +172,10 @@ def chips_per_host_from_ray(ray_module: Any) -> Optional[int]:
         return None
     try:
         nodes = nodes_fn()
-    except Exception:
+    except Exception as exc:
+        from ray_lightning_tpu.reliability import log_suppressed
+        log_suppressed("topology.node_table", exc,
+                       "ray.nodes() unavailable; no per-host chip count")
         return None
     counts = []
     for node in nodes or []:

@@ -1,0 +1,64 @@
+"""Fault tolerance: deterministic fault injection, bounded retry, and
+crash-recovering supervisors.
+
+The reference's launcher detects a dead worker and fails fast
+(``test_worker_exception_fails_fast``); this package owns everything a
+production stack needs *between* "error raised" and "request failed":
+
+- :mod:`~ray_lightning_tpu.reliability.faults` — a seedable
+  :class:`FaultPlan` that injects failures (raise / NaN-poison / stall)
+  at named sites by dispatch index, so chaos paths are exercised
+  deterministically from tests and the bench. Zero overhead when no plan
+  is armed.
+- :mod:`~ray_lightning_tpu.reliability.retry` — :class:`RetryPolicy`
+  (bounded attempts, exponential backoff, deterministic jitter, optional
+  deadline) and :func:`call_with_retry`.
+- :mod:`~ray_lightning_tpu.reliability.supervisor` —
+  :class:`ServeSupervisor` (rebuilds a crashed
+  :class:`~ray_lightning_tpu.serve.engine.ServeEngine` and re-admits
+  every in-flight request by replaying its prompt + emitted tokens, so
+  greedy outputs are token-identical with and without faults) and
+  :class:`FitSupervisor` (re-runs ``Trainer.fit`` with
+  ``ckpt_path="auto"`` under the policy).
+- :mod:`~ray_lightning_tpu.reliability.guard` — the trainer's
+  non-finite loss/gradient guard helpers.
+
+See ``docs/reliability.md`` for the full semantics (fault sites, retry
+contract, the replay-exactness argument, and ``resume="auto"``).
+"""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger("ray_lightning_tpu.reliability")
+
+
+def log_suppressed(site: str, exc: BaseException, detail: str = "") -> None:
+    """Record a swallowed exception instead of silently dropping it.
+
+    The package-wide lint (``tests/test_lint_exceptions.py``) rejects
+    ``except Exception:`` blocks that neither re-raise nor call this —
+    every broad catch must leave a trace an operator can find.
+    """
+    logger.warning("suppressed at %s: %s: %s%s", site,
+                   type(exc).__name__, exc,
+                   f" ({detail})" if detail else "")
+
+
+from ray_lightning_tpu.reliability.faults import (  # noqa: E402
+    FaultPlan, FaultSpec, InjectedFault, MODE_NAN, MODE_RAISE, MODE_STALL,
+    SITE_CKPT_SAVE, SITE_LOADER_NEXT, SITE_SERVE_DISPATCH, SITE_TRAIN_STEP,
+    arm, disarm, fire)
+from ray_lightning_tpu.reliability.guard import NonFiniteError  # noqa: E402
+from ray_lightning_tpu.reliability.retry import (  # noqa: E402
+    RetriesExhausted, RetryPolicy, call_with_retry)
+from ray_lightning_tpu.reliability.supervisor import (  # noqa: E402
+    FitSupervisor, ServeSupervisor)
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "InjectedFault", "MODE_NAN", "MODE_RAISE",
+    "MODE_STALL", "SITE_CKPT_SAVE", "SITE_LOADER_NEXT",
+    "SITE_SERVE_DISPATCH", "SITE_TRAIN_STEP", "arm", "disarm", "fire",
+    "NonFiniteError", "RetriesExhausted", "RetryPolicy", "call_with_retry",
+    "FitSupervisor", "ServeSupervisor", "logger", "log_suppressed",
+]

@@ -1,0 +1,236 @@
+"""Deterministic fault injection at named sites.
+
+Chaos testing on XLA's terms: failures must be *replayable*. A
+:class:`FaultPlan` is a finite schedule of :class:`FaultSpec`\\ s keyed by
+``(site, tick)`` — the ``tick`` is the 0-based count of times that site
+has fired since the plan was armed, NOT wall time — so the same plan
+against the same workload injects the same failures at the same program
+points every run. Tests pin exact recovery behavior; the chaos bench
+pins recovery cost.
+
+Sites are woven into the hot paths as a single ``fire(site)`` call:
+
+====================  ====================================================
+``serve.dispatch``    every :class:`ServeEngine` program dispatch
+                      (prefill *and* decode step count on one clock)
+``train.step``        top of the trainer's batch loop, before the
+                      compiled step
+``ckpt.save``         inside checkpoint writers, *before the commit
+                      point* (a ``raise`` here = killed mid-save)
+``loader.next``       per batch fetched by the trainer's prefetcher
+====================  ====================================================
+
+When no plan is armed (the default), ``fire`` is one global read and a
+``None`` check — the injection machinery costs nothing in production.
+
+Modes: ``raise`` throws :class:`InjectedFault` (a crash), ``nan``
+returns a verdict the call site uses to NaN-poison its payload (only
+meaningful where there is a float payload: ``train.step`` /
+``loader.next``), ``stall`` sleeps ``stall_s`` inside ``fire`` (a slow
+dependency, exercising deadlines/backoff).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ray_lightning_tpu.reliability import logger
+
+SITE_SERVE_DISPATCH = "serve.dispatch"
+SITE_TRAIN_STEP = "train.step"
+SITE_CKPT_SAVE = "ckpt.save"
+SITE_LOADER_NEXT = "loader.next"
+
+MODE_RAISE = "raise"
+MODE_NAN = "nan"
+MODE_STALL = "stall"
+
+# which modes make sense where: nan needs a float payload to poison
+SITES: Dict[str, Tuple[str, ...]] = {
+    SITE_SERVE_DISPATCH: (MODE_RAISE, MODE_STALL),
+    SITE_TRAIN_STEP: (MODE_RAISE, MODE_NAN, MODE_STALL),
+    SITE_CKPT_SAVE: (MODE_RAISE, MODE_STALL),
+    SITE_LOADER_NEXT: (MODE_RAISE, MODE_NAN, MODE_STALL),
+}
+
+
+class InjectedFault(RuntimeError):
+    """The crash a ``mode="raise"`` :class:`FaultSpec` throws."""
+
+    def __init__(self, site: str, tick: int):
+        super().__init__(f"injected fault at {site} tick {tick}")
+        self.site = site
+        self.tick = tick
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled failure: ``site`` fires its ``at``-th time → ``mode``."""
+    site: str
+    at: int
+    mode: str = MODE_RAISE
+    stall_s: float = 0.01
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r}; known: "
+                f"{sorted(SITES)}")
+        if self.mode not in SITES[self.site]:
+            raise ValueError(
+                f"mode {self.mode!r} not supported at {self.site!r} "
+                f"(supported: {SITES[self.site]})")
+        if self.at < 0:
+            raise ValueError(f"at must be >= 0, got {self.at}")
+        if self.stall_s < 0:
+            raise ValueError(f"stall_s must be >= 0, got {self.stall_s}")
+
+
+class FaultPlan:
+    """A deterministic failure schedule over the named sites.
+
+    Arm it around the workload under test::
+
+        plan = FaultPlan.at("serve.dispatch", [0, 3, 7])
+        with plan.armed():
+            client.serve_trace(trace)
+        assert plan.fired == 3
+
+    Each site keeps its own tick counter (incremented on every ``fire``,
+    fault or not), so "the 3rd decode dispatch" is a stable coordinate
+    regardless of wall time or host scheduling. Counters persist across
+    recoveries — a retry's re-dispatch consumes the next tick, which is
+    exactly what lets one plan script "fail the first attempt AND its
+    retry".
+    """
+
+    def __init__(self, specs: Iterable[FaultSpec] = ()):
+        self.specs: List[FaultSpec] = list(specs)
+        self._by_key: Dict[Tuple[str, int], FaultSpec] = {}
+        for spec in self.specs:
+            key = (spec.site, spec.at)
+            if key in self._by_key:
+                raise ValueError(
+                    f"duplicate fault at {spec.site!r} tick {spec.at}")
+            self._by_key[key] = spec
+        self._counts: Dict[str, int] = {site: 0 for site in SITES}
+        self.fired = 0
+
+    # ------------------------------------------------------ constructors
+    @classmethod
+    def at(cls, site: str, ticks: Iterable[int],
+           mode: str = MODE_RAISE, stall_s: float = 0.01) -> "FaultPlan":
+        """Schedule ``mode`` at ``site`` for every tick in ``ticks``."""
+        return cls(FaultSpec(site, int(t), mode, stall_s) for t in ticks)
+
+    @classmethod
+    def random(cls, seed: int, n_faults: int,
+               sites: Sequence[str] = (SITE_SERVE_DISPATCH,),
+               horizon: int = 64,
+               modes: Optional[Sequence[str]] = None) -> "FaultPlan":
+        """Seeded random schedule: same seed → the same plan, always.
+
+        ``n_faults`` faults over ``sites``, ticks uniform in
+        ``[0, horizon)`` without (site, tick) repeats, mode drawn from
+        ``modes`` ∩ the site's supported modes (default: raise only —
+        the mode every site supports).
+        """
+        import numpy as np
+
+        if n_faults > horizon * len(sites):
+            raise ValueError(
+                f"cannot place {n_faults} faults on {len(sites)} sites "
+                f"with horizon {horizon}")
+        rng = np.random.default_rng(seed)
+        specs: List[FaultSpec] = []
+        used = set()
+        while len(specs) < n_faults:
+            site = sites[int(rng.integers(len(sites)))]
+            tick = int(rng.integers(horizon))
+            if (site, tick) in used:
+                continue
+            used.add((site, tick))
+            allowed = [m for m in (modes or (MODE_RAISE,))
+                       if m in SITES[site]]
+            if not allowed:
+                raise ValueError(
+                    f"none of modes {modes} supported at {site!r}")
+            mode = allowed[int(rng.integers(len(allowed)))]
+            specs.append(FaultSpec(site, tick, mode))
+        return cls(specs)
+
+    # ------------------------------------------------------------ firing
+    def counts(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+    def reset(self) -> None:
+        """Zero all tick counters (replay the schedule from the top)."""
+        self._counts = {site: 0 for site in SITES}
+        self.fired = 0
+
+    def fire(self, site: str) -> Optional[str]:
+        """Advance ``site``'s tick; inject if a spec is scheduled there.
+
+        Returns ``None`` (no fault), ``MODE_NAN`` (caller poisons its
+        payload) or ``MODE_STALL`` (the sleep already happened); raises
+        :class:`InjectedFault` for ``MODE_RAISE``.
+        """
+        tick = self._counts[site]
+        self._counts[site] = tick + 1
+        spec = self._by_key.get((site, tick))
+        if spec is None:
+            return None
+        self.fired += 1
+        logger.warning("injecting %s at %s tick %d", spec.mode, site, tick)
+        if spec.mode == MODE_RAISE:
+            raise InjectedFault(site, tick)
+        if spec.mode == MODE_STALL:
+            time.sleep(spec.stall_s)
+        return spec.mode
+
+    # ------------------------------------------------------------ arming
+    def armed(self):
+        """Context manager: install this plan as the process-global one."""
+        return _Armed(self)
+
+
+class _Armed:
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        arm(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc_info) -> None:
+        disarm()
+
+
+_ACTIVE: Optional[FaultPlan] = None
+_LOCK = threading.Lock()
+
+
+def arm(plan: FaultPlan) -> None:
+    global _ACTIVE
+    with _LOCK:
+        if _ACTIVE is not None and _ACTIVE is not plan:
+            raise RuntimeError(
+                "a FaultPlan is already armed; disarm() it first "
+                "(nested plans would make tick counters ambiguous)")
+        _ACTIVE = plan
+
+
+def disarm() -> None:
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+def fire(site: str) -> Optional[str]:
+    """Hot-path hook: no-op (one global read) unless a plan is armed."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.fire(site)

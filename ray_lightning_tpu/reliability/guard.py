@@ -1,0 +1,64 @@
+"""Non-finite loss/gradient guard helpers.
+
+The guard itself lives in two places: device-side,
+``Strategy.make_train_step(guard_nonfinite=True)`` folds
+:func:`tree_all_finite` over the gradients and *selects the old state*
+when the update is poisoned (no host round-trip, donation-safe — the
+revert happens inside the compiled program, where both old and new
+buffers still exist); host-side, the Trainer reads the step's
+``nonfinite`` flag and applies the configured action (``raise`` /
+``skip_batch`` / ``restore_last_ckpt``).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class NonFiniteError(RuntimeError):
+    """A training step produced a NaN/Inf loss or gradient and the
+    trainer's ``nonfinite_action`` is ``"raise"`` (or recovery was
+    impossible, e.g. ``restore_last_ckpt`` with no checkpoint yet)."""
+
+
+def tree_all_finite(tree: Any):
+    """Scalar bool array: every element of every float leaf is finite.
+
+    Exact (per-element ``isfinite``, not a norm probe): a global-norm
+    check can overflow to inf on large-but-finite gradients and
+    false-positive the guard.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)]
+    ok = jnp.asarray(True)
+    for leaf in leaves:
+        ok = ok & jnp.isfinite(leaf).all()
+    return ok
+
+
+def poison_nan(batch: Any) -> Any:
+    """NaN-fill every float leaf of a host batch (``mode="nan"`` faults).
+
+    Int-only batches (e.g. token ids) have nothing to poison — that is a
+    misconfigured fault plan, not a silent no-op."""
+    import jax
+
+    found = []
+
+    def _p(x):
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.floating):
+            found.append(True)
+            return np.full_like(a, np.nan)
+        return x
+
+    out = jax.tree_util.tree_map(_p, batch)
+    if not found:
+        raise ValueError(
+            "nan fault injected but the batch has no float leaves to "
+            "poison; use mode='raise' for integer-only pipelines")
+    return out

@@ -1,0 +1,199 @@
+"""Crash-recovering supervisors for serving and training.
+
+**ServeSupervisor** sits between :class:`ServeClient` and
+:class:`ServeEngine` with the engine's exact interface (everything it
+doesn't override is delegated to the live engine). On a dispatch crash
+it rebuilds the engine from its constructor args and *re-admits every
+in-flight request by replay*: each request's prompt + already-emitted
+tokens go back through one prefill pass, which reconstructs the KV cache
+the crashed engine held and samples the next token with the key the
+original stream would have used (``fold_in(fold_in(base, seed), k)`` for
+a request that had emitted ``k`` tokens — see
+``docs/reliability.md#replay-exactness``). Greedy outputs are therefore
+token-identical with and without faults; sampled outputs are
+replay-exact because the per-request key stream is a pure function of
+``(engine seed, request seed, step)``, never of slots or batch
+composition. After the retry policy is exhausted the in-flight requests
+retire as ``finish_reason="failed"`` completions — the client loop and
+the waiting queue keep running; overload and crashes shed *requests*,
+not the server.
+
+**FitSupervisor** re-runs ``Trainer.fit`` with ``ckpt_path="auto"``
+under the same policy: each attempt gets a *fresh* trainer (a crashed
+one may hold poisoned device state) and resumes from the newest valid
+checkpoint on disk.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ray_lightning_tpu.reliability import log_suppressed, logger
+from ray_lightning_tpu.reliability.retry import (RetriesExhausted,
+                                                 RetryPolicy,
+                                                 call_with_retry)
+from ray_lightning_tpu.serve.request import (Completion, FINISH_FAILED,
+                                             Request)
+
+
+class ServeSupervisor:
+    """Engine proxy: same dispatch surface, plus rebuild-and-replay.
+
+    ``ServeSupervisor(model, params, policy=RetryPolicy(...),
+    **engine_kwargs)`` — or let :class:`ServeClient` build one by
+    passing ``retry_policy=``. Attribute access falls through to the
+    live engine, so scheduler/bench probes (``free_slots``,
+    ``decode_substeps``, …) keep working; note engine counters reset
+    when a crash forces a rebuild — use the supervisor's own
+    ``rebuilds`` / ``recoveries`` / ``failed_requests`` /
+    ``recovery_s_total`` for reliability accounting.
+    """
+
+    def __init__(self, model, params, *,
+                 policy: Optional[RetryPolicy] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 **engine_kwargs: Any):
+        from ray_lightning_tpu.serve.engine import ServeEngine
+        self._engine_cls = ServeEngine
+        self.policy = policy or RetryPolicy()
+        self._model = model
+        self._params = params
+        self._engine_kwargs = dict(engine_kwargs)
+        self._sleep = sleep
+        self.engine = ServeEngine(model, params, **engine_kwargs)
+        self.rebuilds = 0
+        self.recoveries = 0
+        self.failed_requests = 0
+        self.recovery_s_total = 0.0
+
+    def __getattr__(self, name: str) -> Any:
+        # only reached for names not set on the supervisor itself
+        return getattr(self.engine, name)
+
+    # ------------------------------------------------------- dispatches
+    def prefill(self, requests: List[Request]) -> List[Completion]:
+        return self._dispatch("prefill", requests)
+
+    def step(self) -> List[Completion]:
+        return self._dispatch("step")
+
+    def _dispatch(self, op: str,
+                  requests: Sequence[Request] = ()) -> List[Completion]:
+        from ray_lightning_tpu.serve.engine import SlotPoolFull
+        try:
+            if op == "prefill":
+                return self.engine.prefill(list(requests))
+            return self.engine.step()
+        except (SlotPoolFull, ValueError):
+            # admission-contract errors (pool full, seed collision, shape
+            # that can never fit): the caller's scheduler handles these —
+            # they are refusals, not crashes
+            raise
+        except Exception as exc:  # noqa: BLE001 — routed to recovery
+            log_suppressed("serve.dispatch", exc,
+                           f"{op} crashed; entering recovery")
+            # snapshot only now — the crash-free hot path never pays the
+            # per-dispatch token copy. A failed dispatch records no
+            # tokens, so the snapshot is the pre-dispatch truth; a
+            # crashed prefill may have already acquired slots for the
+            # incoming batch (tokens: none), so dedupe by request id
+            # before adding the batch with an empty replay.
+            snapshot = self.engine.snapshot_in_flight()
+            seen = {req.id for req, _toks in snapshot}
+            entries = snapshot + [(req, []) for req in requests
+                                  if req.id not in seen]
+            return self._recover(entries)
+
+    # ---------------------------------------------------------- recovery
+    def _recover(self, entries: List[Tuple[Request, List[int]]]
+                 ) -> List[Completion]:
+        """Rebuild + replay under the policy (attempt count AND deadline
+        both honored via call_with_retry); fail the batch after it."""
+        t0 = time.perf_counter()
+        self.recoveries += 1
+        try:
+            done = call_with_retry(
+                lambda attempt: self._rebuild_and_replay(entries),
+                self.policy, site="serve.recovery", sleep=self._sleep)
+            # failed completions produced by a SUCCESSFUL replay pass
+            # (unreplayable prompt+emitted overflow) count exactly once
+            self.failed_requests += sum(
+                1 for c in done if c.finish_reason == FINISH_FAILED)
+            self.recovery_s_total += time.perf_counter() - t0
+            return done
+        except RetriesExhausted as exc:
+            # exhausted: a clean empty engine, and every entry retires
+            # as a "failed" completion carrying the tokens it did
+            # produce — the client loop and queued requests continue
+            logger.error(
+                "serve recovery exhausted (%s); retiring %d request(s) "
+                "as failed", exc, len(entries))
+            self.engine = self._engine_cls(self._model, self._params,
+                                           **self._engine_kwargs)
+            self.rebuilds += 1
+            self.failed_requests += len(entries)
+            self.recovery_s_total += time.perf_counter() - t0
+            return [
+                Completion(request_id=req.id, prompt=list(req.prompt),
+                           tokens=list(toks), finish_reason=FINISH_FAILED,
+                           arrival_time=req.arrival_time,
+                           first_token_time=req.first_token_time)
+                for req, toks in entries
+            ]
+
+    def _rebuild_and_replay(self, entries: List[Tuple[Request, List[int]]]
+                            ) -> List[Completion]:
+        self.engine = self._engine_cls(self._model, self._params,
+                                       **self._engine_kwargs)
+        self.rebuilds += 1
+        done: List[Completion] = []
+        pending: List[Request] = []
+        for req, toks in entries:
+            if req.prompt_len + len(toks) > self.engine.prefill_len:
+                # prompt + emitted no longer fits one prefill pass: this
+                # request cannot be replayed (docs/reliability.md names
+                # the prefill_len >= prompt + expected tokens sizing
+                # rule); counted by _recover iff this attempt commits
+                done.append(Completion(
+                    request_id=req.id, prompt=list(req.prompt),
+                    tokens=list(toks), finish_reason=FINISH_FAILED,
+                    arrival_time=req.arrival_time,
+                    first_token_time=req.first_token_time))
+                continue
+            req.replay_tokens = list(toks)
+            pending.append(req)
+        B = self.engine.prefill_batch
+        for i in range(0, len(pending), B):
+            done.extend(self.engine.prefill(pending[i:i + B]))
+        return done
+
+
+class FitSupervisor:
+    """Run ``Trainer.fit`` to completion under a retry policy.
+
+    ``make_trainer`` builds a *fresh* trainer per attempt (never reuse a
+    crashed one — its device state may be poisoned); ``module`` may be an
+    instance or a zero-arg factory. Every attempt fits with
+    ``ckpt_path="auto"``, so attempt N+1 resumes from the newest valid
+    checkpoint attempt N managed to commit. Raises
+    :class:`RetriesExhausted` when the policy runs out.
+    """
+
+    def __init__(self, make_trainer: Callable[[], Any],
+                 policy: Optional[RetryPolicy] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.make_trainer = make_trainer
+        self.policy = policy or RetryPolicy()
+        self._sleep = sleep
+        self.attempts = 0
+
+    def fit(self, module: Any, datamodule: Any = None):
+        """Returns the trainer whose fit completed."""
+        def attempt(i: int):
+            self.attempts = i
+            trainer = self.make_trainer()
+            mod = module() if callable(module) else module
+            trainer.fit(mod, datamodule=datamodule, ckpt_path="auto")
+            return trainer
+        return call_with_retry(attempt, self.policy, site="trainer.fit",
+                               sleep=self._sleep)

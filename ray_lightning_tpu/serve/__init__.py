@@ -14,7 +14,8 @@ from ray_lightning_tpu.serve.client import ServeClient
 from ray_lightning_tpu.serve.engine import (KVSlotPool, ServeEngine,
                                             SlotPoolFull)
 from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
-                                             FINISH_LENGTH, FINISH_REJECTED,
+                                             FINISH_FAILED, FINISH_LENGTH,
+                                             FINISH_REJECTED,
                                              FINISH_TIMEOUT, Request)
 from ray_lightning_tpu.serve.scheduler import (FifoScheduler, QueueFull,
                                                SchedulerConfig)
@@ -22,6 +23,6 @@ from ray_lightning_tpu.serve.scheduler import (FifoScheduler, QueueFull,
 __all__ = [
     "ServeClient", "ServeEngine", "KVSlotPool", "SlotPoolFull",
     "Request", "Completion", "FifoScheduler", "QueueFull",
-    "SchedulerConfig", "FINISH_EOS", "FINISH_LENGTH", "FINISH_REJECTED",
-    "FINISH_TIMEOUT",
+    "SchedulerConfig", "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH",
+    "FINISH_REJECTED", "FINISH_TIMEOUT",
 ]

@@ -44,11 +44,22 @@ class ServeClient:
                  prefill_len: int = 64, steps_per_dispatch: int = 1,
                  scheduler_config: Optional[SchedulerConfig] = None,
                  seed: int = 0,
-                 clock: Optional[Callable[[], float]] = None):
-        self.engine = ServeEngine(
-            model, params, num_slots=num_slots,
-            prefill_batch=prefill_batch, prefill_len=prefill_len,
+                 clock: Optional[Callable[[], float]] = None,
+                 retry_policy=None):
+        engine_kwargs = dict(
+            num_slots=num_slots, prefill_batch=prefill_batch,
+            prefill_len=prefill_len,
             steps_per_dispatch=steps_per_dispatch, seed=seed)
+        if retry_policy is not None:
+            # supervised engine: dispatch crashes rebuild + replay under
+            # the policy instead of unwinding through the client loop;
+            # exhausted requests retire as finish_reason="failed"
+            from ray_lightning_tpu.reliability import ServeSupervisor
+            self.engine = ServeSupervisor(model, params,
+                                          policy=retry_policy,
+                                          **engine_kwargs)
+        else:
+            self.engine = ServeEngine(model, params, **engine_kwargs)
         self.scheduler = FifoScheduler(scheduler_config)
         self._clock = clock
         self._t0: Optional[float] = None

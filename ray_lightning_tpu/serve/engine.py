@@ -45,6 +45,7 @@ import numpy as np
 from ray_lightning_tpu.models.generate import (_prefill_impl, decode_step,
                                                sample_logits_rows)
 from ray_lightning_tpu.models.transformer import latch_eos
+from ray_lightning_tpu.reliability import faults
 from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_LENGTH, FINISH_TIMEOUT,
                                              Request)
@@ -118,7 +119,7 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
 
 
 def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
-                         slots, valid, keys, temp, top_k):
+                         slots, valid, keys, temp, top_k, startno):
     """Batched prompt fill + first-token sample + KV injection.
 
     Runs the standard single-pass prefill at the engine's fixed
@@ -128,10 +129,17 @@ def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
     whole KV row into its assigned pool slot. Invalid (padding) rows are
     computed but written nowhere — the pool row is read back and kept, so
     one compiled program covers every fill level of the prefill batch.
+
+    ``startno`` (B,) is each row's sampling-step offset: 0 for a fresh
+    request (fold_in(key, 0), the original behavior), k for a
+    crash-recovery replay whose row re-feeds the prompt + k emitted
+    tokens — the sampled token then continues the request's key stream
+    exactly where the dead engine left it (same array shapes, so replay
+    reuses the compiled program).
     """
     B_pf = prompts.shape[0]
     pf_cache, last = _prefill_impl(model, params, prompts, lengths)
-    first_keys = _fold_rows(keys, jnp.zeros((B_pf,), jnp.int32))
+    first_keys = _fold_rows(keys, startno)
     first = sample_logits_rows(last, first_keys, temp, top_k)
 
     # cache leaves: cached_key/cached_value are (B, L, H, D) unrolled or
@@ -339,9 +347,17 @@ class ServeEngine:
         """Start ``requests``: one fixed-shape prefill pass, first tokens
         sampled, KV rows injected into freshly acquired slots. Returns
         completions for requests that finish ON their first token
-        (eos-on-first or ``max_new_tokens=1``)."""
+        (eos-on-first or an exhausted budget).
+
+        A request carrying ``replay_tokens`` (crash recovery, see
+        :class:`~ray_lightning_tpu.reliability.ServeSupervisor`) re-feeds
+        its prompt + those tokens: the prefill rebuilds exactly the KV
+        the dead engine held and the sampled token continues the
+        request's key stream at step ``len(replay_tokens)``.
+        """
         if not requests:
             return []
+        faults.fire("serve.dispatch")
         if len(requests) > min(self.free_slots, self.prefill_batch):
             raise SlotPoolFull(
                 f"{len(requests)} requests > min(free_slots="
@@ -354,14 +370,22 @@ class ServeEngine:
         keys = np.zeros((B_pf, 2), np.uint32)
         temp = np.zeros((B_pf,), np.float32)
         top_k = np.zeros((B_pf,), np.int32)
+        startno = np.zeros((B_pf,), np.int32)
         acquired = []
         try:
             for r, req in enumerate(requests):
                 self.validate(req)
+                replay = list(req.replay_tokens or ())
+                L = req.prompt_len + len(replay)
+                if L > self.prefill_len:
+                    raise ValueError(
+                        f"request {req.id}: prompt ({req.prompt_len}) + "
+                        f"replayed tokens ({len(replay)}) exceed "
+                        f"prefill_len ({self.prefill_len}) — not "
+                        "resumable in one prefill pass")
                 slot = self.pool.acquire(req)
                 acquired.append(slot)
-                L = req.prompt_len
-                prompts[r, :L] = req.prompt
+                prompts[r, :L] = list(req.prompt) + replay
                 lengths[r] = L
                 valid[r] = True
                 slots[r] = slot
@@ -369,6 +393,7 @@ class ServeEngine:
                     jax.random.fold_in(self._base_key, req.seed))
                 temp[r] = req.temperature
                 top_k[r] = req.top_k or 0
+                startno[r] = len(replay)
         except Exception:
             # atomic admission: a mid-batch reject (seed collision, bad
             # shape) must not leak the slots already acquired
@@ -383,29 +408,30 @@ class ServeEngine:
         fn = _pick(_prefill_inject_donated, _prefill_inject_plain)
         self.pool.cache, first = fn(
             self.model, self.params, self.pool.cache, prompts, lengths,
-            slots, valid, keys, temp, top_k)
+            slots, valid, keys, temp, top_k, startno)
         first = np.asarray(first)
 
         done: List[Completion] = []
         for r, req in enumerate(requests):
             slot = acquired[r]
             tok = int(first[r])
-            self._tokens[slot] = [tok]
+            toks = list(req.replay_tokens or ()) + [tok]
+            self._tokens[slot] = toks
             self.tokens_generated += 1
             hit_eos = req.eos_id is not None and tok == req.eos_id
-            if hit_eos or req.max_new_tokens == 1:
+            if hit_eos or len(toks) >= req.max_new_tokens:
                 done.append(self._retire(
                     slot, FINISH_EOS if hit_eos else FINISH_LENGTH))
                 continue
             self._cur[slot, 0] = tok
-            self._pos[slot, 0] = req.prompt_len
+            self._pos[slot, 0] = req.prompt_len + len(toks) - 1
             self._active[slot] = True
-            self._remaining[slot] = req.max_new_tokens - 1
+            self._remaining[slot] = req.max_new_tokens - len(toks)
             self._temp[slot] = req.temperature
             self._top_k[slot] = req.top_k or 0
             self._eos[slot] = -1 if req.eos_id is None else req.eos_id
             self._keys[slot] = keys[r]
-            self._stepno[slot] = 1
+            self._stepno[slot] = len(toks)
         self.prefills += 1
         return done
 
@@ -416,6 +442,7 @@ class ServeEngine:
         sub-step k park idempotently for the remaining sub-steps)."""
         if not self._active.any():
             return []
+        faults.fire("serve.dispatch")
         fn = _pick(_engine_step_donated, _engine_step_plain)
         (self.pool.cache, cur, pos, active, remaining, stepno, emitted,
          finished) = fn(
@@ -450,6 +477,14 @@ class ServeEngine:
         return done
 
     # -------------------------------------------------------- lifecycle
+    def snapshot_in_flight(self) -> List:
+        """``[(request, tokens_emitted_so_far)]`` for every in-flight
+        slot, in slot order — what a supervisor needs to re-admit this
+        engine's work after a crash (copies, never live buffers)."""
+        return [(self.pool.active[slot],
+                 list(self._tokens.get(slot, [])))
+                for slot in sorted(self.pool.active)]
+
     def cancel(self, request_id: int,
                reason: str = FINISH_TIMEOUT) -> Optional[Completion]:
         """Abort an in-flight request (deadline expiry): frees its slot,

@@ -22,6 +22,7 @@ FINISH_EOS = "eos"            # sampled its eos id
 FINISH_LENGTH = "length"      # exhausted max_new_tokens
 FINISH_TIMEOUT = "timeout"    # deadline expired (queued or mid-decode)
 FINISH_REJECTED = "rejected"  # shed at admission (trace replay only)
+FINISH_FAILED = "failed"      # engine crash recovery exhausted its retries
 
 
 @dataclasses.dataclass
@@ -50,6 +51,12 @@ class Request:
     # timing bookkeeping, stamped by the driving client (clock units)
     arrival_time: Optional[float] = None
     first_token_time: Optional[float] = None
+    # crash-recovery replay (set by ServeSupervisor, never by submit):
+    # tokens this request had already emitted before its engine died.
+    # Prefill re-feeds prompt + replay_tokens and resumes the sampling
+    # key stream at step len(replay_tokens) — replay-exact, see
+    # docs/reliability.md.
+    replay_tokens: Optional[List[int]] = None
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]

@@ -40,7 +40,9 @@ class HorovodRayStrategy(Strategy):
     def make_train_step(self, loss_fn: Callable, tx: optax.GradientTransformation,
                         state_shardings: Any, batch_sharding: NamedSharding,
                         donate: bool = True,
-                        log_grad_norm: bool = False) -> Callable:
+                        log_grad_norm: bool = False,
+                        guard_nonfinite: bool = False) -> Callable:
+        from ray_lightning_tpu.reliability.guard import tree_all_finite
         mesh = self.mesh
 
         def per_rank_step(state, batch):
@@ -68,6 +70,17 @@ class HorovodRayStrategy(Strategy):
                 new_ms)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+            if guard_nonfinite:
+                # checked on the post-allreduce grads, so every rank
+                # reaches the same keep/skip verdict with no extra
+                # collective (the pmean already synchronized them)
+                ok = jnp.isfinite(loss) & tree_all_finite(grads)
+                keep = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+                    lambda n, o: jnp.where(ok, n, o), new, old)
+                new_params = keep(new_params, state.params)
+                new_opt = keep(new_opt, state.opt_state)
+                new_ms = keep(new_ms, state.model_state)
+                logs = {**logs, "nonfinite": (~ok).astype(jnp.float32)}
             new_state = state.replace(
                 step=state.step + 1, params=new_params, opt_state=new_opt,
                 model_state=new_ms)
@@ -80,6 +93,9 @@ class HorovodRayStrategy(Strategy):
             in_specs=(P(), batch_spec),
             out_specs=(P(), P()),
             check_vma=False)
+        # CPU gating as in Strategy.make_train_step: donation + zero-copy
+        # host buffers alias on the CPU backend (use-after-free garbage)
+        donate = donate and jax.default_backend() != "cpu"
         return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
     def join(self) -> None:

@@ -20,6 +20,7 @@ import warnings
 from typing import Any, Callable, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_lightning_tpu.launchers.local import LocalLauncher
@@ -212,7 +213,8 @@ class Strategy:
     def make_train_step(self, loss_fn: Callable, tx: Any,
                         state_shardings: Any, batch_sharding: NamedSharding,
                         donate: bool = True,
-                        log_grad_norm: bool = False) -> Callable:
+                        log_grad_norm: bool = False,
+                        guard_nonfinite: bool = False) -> Callable:
         """Build the compiled training step: ``state', logs = step(state, batch)``.
 
         The jit path: gradient synchronization is *derived* by XLA from the
@@ -225,8 +227,19 @@ class Strategy:
         ``log_grad_norm`` adds the pre-clip global gradient norm to the
         step logs — computed inside the same XLA program (fused with the
         update), so it costs no extra host sync.
+
+        ``guard_nonfinite`` (the trainer's ``nonfinite_action`` seat)
+        checks the loss AND every gradient element for NaN/Inf inside
+        the compiled program; a poisoned step keeps the old
+        params/opt/model state (a device-side select — donation-safe,
+        both versions exist inside the program) and reports
+        ``logs["nonfinite"]=1.0`` for the host to act on. The step/rng
+        counters still advance: the batch was *attempted*, and the next
+        batch draws fresh randomness.
         """
         import optax
+
+        from ray_lightning_tpu.reliability.guard import tree_all_finite
 
         def step(state, batch):
             rng = jax.random.fold_in(state.rng, state.step)
@@ -237,11 +250,29 @@ class Strategy:
                 logs = {**logs, "grad_norm": optax.global_norm(grads)}
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+            if guard_nonfinite:
+                ok = jnp.isfinite(loss) & tree_all_finite(grads)
+                keep = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+                    lambda n, o: jnp.where(ok, n, o), new, old)
+                new_params = keep(new_params, state.params)
+                new_opt = keep(new_opt, state.opt_state)
+                new_ms = keep(new_ms, state.model_state)
+                logs = {**logs,
+                        "nonfinite": (~ok).astype(jnp.float32)}
             new_state = state.replace(
                 step=state.step + 1, params=new_params, opt_state=new_opt,
                 model_state=new_ms)
             return new_state, {"loss": loss, **logs}
 
+        # Donation is gated off on the CPU backend, same as the serve
+        # engine's _pick(): CPU jax honors donation by aliasing buffers
+        # in place, and CPU device_put/device_get are ZERO-COPY — so a
+        # donated step can overwrite memory that host numpy still views
+        # (checkpoint-restored states, test snapshots), which surfaces
+        # as use-after-free garbage/NaN. Real accelerators copy across
+        # the host/HBM boundary, so donation there is both safe and the
+        # memory win it exists for.
+        donate = donate and jax.default_backend() != "cpu"
         return jax.jit(
             step,
             in_shardings=(state_shardings, batch_sharding),

@@ -67,7 +67,10 @@ def is_session_enabled() -> bool:
     if _is_legacy_tune(tune):
         try:
             return bool(tune.is_session_enabled())
-        except Exception:
+        except Exception as exc:
+            from ray_lightning_tpu.reliability import log_suppressed
+            log_suppressed("tune.session_probe", exc,
+                           "legacy is_session_enabled failed")
             return False
     # Ray >= 2.x: a live train/tune session context marks the trial
     # process. Public API first (round-2 review: the private-module probe
@@ -77,12 +80,17 @@ def is_session_enabled() -> bool:
         ctx = tune.get_context()
         if ctx is not None and ctx.get_trial_id() is not None:
             return True
-    except Exception:
-        pass
+    except Exception as exc:
+        from ray_lightning_tpu.reliability import log_suppressed
+        log_suppressed("tune.session_probe", exc,
+                       "get_context raised outside a session")
     try:
         from ray.train._internal.session import _get_session
         return _get_session() is not None
-    except Exception:
+    except Exception as exc:
+        from ray_lightning_tpu.reliability import log_suppressed
+        log_suppressed("tune.session_probe", exc,
+                       "private _get_session fallback failed")
         return False
 
 

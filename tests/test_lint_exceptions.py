@@ -1,0 +1,73 @@
+"""AST lint: broad exception handlers must re-raise or leave a trace.
+
+Sibling of ``test_lint_unreachable.py``. A silent ``except Exception:
+pass`` is how fault-tolerance rots: the reliability layer (PR 3) exists
+to route failures somewhere visible, so every broad catch in the package
+must either
+
+- contain a ``raise`` (re-raise / translate), or
+- call :func:`ray_lightning_tpu.reliability.log_suppressed` (the
+  reliability logger's swallowed-exception channel), or
+- carry an explicit ``tl-lint: allow-broad-except`` marker on the
+  ``except`` line with a justification (e.g. ``__del__`` during
+  interpreter teardown, where logging may already be gone).
+
+"Broad" = ``except Exception``, a tuple containing it, or a bare
+``except:``. Narrow catches (``except ValueError``) and
+``except BaseException`` (which the sibling rule of "must cross the
+process boundary" governs — both package uses re-raise or ship the
+error) are out of scope.
+"""
+import ast
+import pathlib
+
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "ray_lightning_tpu"
+
+MARKER = "tl-lint: allow-broad-except"
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    if isinstance(t, ast.Name) and t.id == "Exception":
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id == "Exception"
+                   for e in t.elts)
+    return False
+
+
+def _is_handled(handler: ast.ExceptHandler, lines) -> bool:
+    if MARKER in lines[handler.lineno - 1]:
+        return True
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name == "log_suppressed":
+                return True
+    return False
+
+
+@pytest.mark.parametrize(
+    "path", sorted(PKG.rglob("*.py")), ids=lambda p: str(p.relative_to(PKG)))
+def test_broad_excepts_reraise_or_log(path):
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    offenders = [
+        f"{path.relative_to(PKG.parent)}:{h.lineno}"
+        for h in ast.walk(tree)
+        if isinstance(h, ast.ExceptHandler) and _is_broad(h)
+        and not _is_handled(h, lines)
+    ]
+    assert not offenders, (
+        "broad `except Exception:` without re-raise or "
+        "reliability.log_suppressed (add the handler to the reliability "
+        f"layer, or mark `# {MARKER} — <why>`): " + ", ".join(offenders))

@@ -247,11 +247,18 @@ def test_ema_weight_averaging_math(tmp_root):
     ema_cb = EMAWeightAveraging(decay=decay)
     init_params = []
     snapshots = []
+    # DEEP-copy every snapshot (np.array, not device_get alone): on the
+    # CPU backend device_get returns zero-copy VIEWS of the live
+    # buffers, and the donated train step reuses/overwrites them in
+    # place — un-copied snapshots all silently mutate into the final
+    # params (the seed-era "EMA math" failure; see docs/testing.md).
+    snap = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        np.array, jax.device_get(tree))
     probe = LambdaCallback(
         on_train_start=lambda tr, m: init_params.append(
-            jax.device_get(tr.train_state.params)),
+            snap(tr.train_state.params)),
         on_train_batch_end=lambda tr, m, out, b, i: snapshots.append(
-            jax.device_get(tr.train_state.params)))
+            snap(tr.train_state.params)))
     _fit(tmp_root, [probe, ema_cb], strategy=RayStrategy(num_workers=2),
          max_epochs=1, enable_checkpointing=False)
     assert len(snapshots) == 3

@@ -1,0 +1,453 @@
+"""Chaos paths: fault injection, retry/backoff, replay recovery, resume.
+
+The load-bearing assertions (ISSUE 3 pinned tests):
+
+- with a fault plan injecting >= 3 dispatch failures spanning prefill
+  AND decode, greedy ``ServeClient.serve_trace`` completions are
+  token-identical to the fault-free run (the supervisor rebuilds the
+  engine and replays prompt + emitted tokens; per-request fold_in keys
+  make the sampled continuation replay-exact), and
+- a trainer killed mid-run by a ``train.step`` fault auto-resumes
+  (``resume="auto"``) to the same final greedy eval loss — in fact the
+  same *bitwise* params — as an uninterrupted run, from epoch-end AND
+  mid-epoch periodic checkpoints.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import (ModelCheckpoint, NonFiniteError, RayStrategy,
+                               Trainer)
+from ray_lightning_tpu.models import BoringModel, TransformerLM, gpt2_config
+from ray_lightning_tpu.reliability import (FaultPlan, FaultSpec,
+                                           FitSupervisor, InjectedFault,
+                                           RetriesExhausted, RetryPolicy,
+                                           ServeSupervisor, call_with_retry,
+                                           faults)
+from ray_lightning_tpu.serve import FINISH_FAILED, ServeClient
+
+
+# --------------------------------------------------------------------- #
+# fault plans
+# --------------------------------------------------------------------- #
+def test_fault_plan_random_deterministic():
+    """Same seed -> the same failure schedule, spec for spec."""
+    kw = dict(n_faults=6, sites=("serve.dispatch", "train.step"),
+              horizon=32, modes=("raise", "nan"))
+    a = FaultPlan.random(7, **kw)
+    b = FaultPlan.random(7, **kw)
+    assert a.specs == b.specs and len(a.specs) == 6
+    assert FaultPlan.random(8, **kw).specs != a.specs
+    # replays identically after reset: same ticks fire again
+    with a.armed():
+        for _ in range(32):
+            try:
+                a.fire("train.step")
+            except InjectedFault:
+                pass
+    first_round = a.counts()
+    fired = a.fired
+    a.reset()
+    assert a.counts()["train.step"] == 0
+    with a.armed():
+        for _ in range(32):
+            try:
+                a.fire("train.step")
+            except InjectedFault:
+                pass
+    assert a.counts() == first_round and a.fired == fired
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultSpec("serve.bogus", 0)
+    with pytest.raises(ValueError, match="not supported"):
+        FaultSpec("serve.dispatch", 0, mode="nan")  # no float payload
+    with pytest.raises(ValueError, match="duplicate"):
+        FaultPlan.at("train.step", [1, 1])
+
+
+def test_arming_is_exclusive_and_fire_is_noop_when_disarmed():
+    assert faults.fire("train.step") is None  # no plan -> no-op
+    plan = FaultPlan.at("train.step", [0])
+    with plan.armed():
+        with pytest.raises(RuntimeError, match="already armed"):
+            faults.arm(FaultPlan())
+        with pytest.raises(InjectedFault):
+            faults.fire("train.step")
+    assert faults.fire("train.step") is None  # disarmed on exit
+
+
+# --------------------------------------------------------------------- #
+# retry policy
+# --------------------------------------------------------------------- #
+def test_retry_policy_backoff_deterministic():
+    p = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.5,
+                    multiplier=2.0, jitter=0.2, seed=3)
+    delays = [p.delay(i) for i in range(1, 5)]
+    assert delays == [p.delay(i) for i in range(1, 5)]  # pure function
+    # exponential shape within the jitter band, capped at max_delay
+    for i, d in enumerate(delays):
+        nominal = min(0.5, 0.1 * 2.0 ** i)
+        assert 0.8 * nominal <= d <= 1.2 * nominal
+    assert RetryPolicy(jitter=0.0, base_delay=0.1).delay(1) == 0.1
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+
+
+def test_call_with_retry_exhaustion_chains_last_error():
+    sleeps = []
+    calls = []
+
+    def flaky(attempt):
+        calls.append(attempt)
+        raise OSError(f"boom {attempt}")
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.25, jitter=0.0)
+    with pytest.raises(RetriesExhausted) as exc_info:
+        call_with_retry(flaky, policy, sleep=sleeps.append)
+    assert calls == [1, 2, 3]
+    assert sleeps == [0.25, 0.5]  # backoff between attempts, none after
+    assert isinstance(exc_info.value.last_error, OSError)
+    assert "boom 3" in str(exc_info.value)
+
+    # success on a later attempt returns and stops retrying
+    def heals(attempt):
+        if attempt < 3:
+            raise OSError("still down")
+        return "ok"
+
+    assert call_with_retry(heals, policy, sleep=lambda s: None) == "ok"
+
+
+# --------------------------------------------------------------------- #
+# serve: rebuild-and-replay
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+TRACE = [
+    (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+    (0, dict(prompt=[9, 2, 44], max_new_tokens=6)),
+    (3, dict(prompt=[42, 7], max_new_tokens=5)),
+    (5, dict(prompt=[1], max_new_tokens=6)),
+]
+
+
+def _serve(dec, params, trace, *, plan=None, policy=None, **kw):
+    client = ServeClient(dec, params, num_slots=3, prefill_len=24,
+                         retry_policy=policy, **kw)
+    if plan is None:
+        return client, client.serve_trace(trace)
+    with plan.armed():
+        return client, client.serve_trace(trace)
+
+
+@pytest.mark.parametrize("steps_per_dispatch", [1, 3])
+def test_serve_replay_token_identity_greedy(nano, steps_per_dispatch):
+    """PINNED: >=3 injected dispatch failures — tick 0 is the first
+    prefill, later ticks land mid-decode — and greedy completions stay
+    token-identical to the fault-free run, none marked failed."""
+    dec, params = nano
+    _, base = _serve(dec, params, TRACE,
+                     steps_per_dispatch=steps_per_dispatch)
+    plan = FaultPlan.at("serve.dispatch", [0, 3, 7])
+    client, out = _serve(dec, params, TRACE, plan=plan,
+                         policy=RetryPolicy(max_attempts=3, base_delay=0.0),
+                         steps_per_dispatch=steps_per_dispatch)
+    assert plan.fired == 3
+    assert client.engine.rebuilds >= 3
+    for rid in base:
+        assert out[rid].tokens == base[rid].tokens, rid
+        assert out[rid].finish_reason == base[rid].finish_reason
+    assert all(c.finish_reason != FINISH_FAILED for c in out.values())
+
+
+def test_serve_replay_exact_with_sampling_and_eos(nano):
+    """Replay-exactness beyond greedy: temperature>0 rows continue their
+    per-request key stream across a rebuild (fold_in(key, k) at replayed
+    step k), and eos latching still retires rows correctly."""
+    dec, params = nano
+    trace = [
+        (0, dict(prompt=[5, 17, 3], max_new_tokens=8, temperature=0.9,
+                 top_k=20, seed=11)),
+        (1, dict(prompt=[9, 2], max_new_tokens=8, temperature=0.7,
+                 seed=23, eos_id=100)),
+        (2, dict(prompt=[42], max_new_tokens=8, eos_id=100)),
+    ]
+    _, base = _serve(dec, params, trace)
+    plan = FaultPlan.at("serve.dispatch", [2, 5])
+    _, out = _serve(dec, params, trace, plan=plan,
+                    policy=RetryPolicy(max_attempts=2, base_delay=0.0))
+    for rid in base:
+        assert out[rid].tokens == base[rid].tokens, rid
+        assert out[rid].finish_reason == base[rid].finish_reason
+
+
+def test_serve_retry_exhaustion_fails_requests_and_drains(nano):
+    """Every dispatch crashing: after max_attempts the in-flight batch
+    retires as finish_reason='failed' and the client loop still drains
+    the queue (completions exist for every request, loop terminates)."""
+    dec, params = nano
+    plan = FaultPlan.at("serve.dispatch", range(64))
+    client, out = _serve(
+        dec, params, TRACE, plan=plan,
+        policy=RetryPolicy(max_attempts=2, base_delay=0.0))
+    assert len(out) == len(TRACE)
+    assert all(c.finish_reason == FINISH_FAILED for c in out.values())
+    assert client.engine.failed_requests >= len(TRACE)
+    assert len(client.scheduler) == 0 and client.engine.active_count == 0
+
+
+def test_serve_replay_overflow_prefill_len_fails_gracefully(nano):
+    """A request whose prompt + emitted tokens outgrow prefill_len cannot
+    be replayed in one pass: it retires failed WITH its partial tokens
+    instead of wedging recovery (docs/reliability.md sizing rule)."""
+    dec, params = nano
+    trace = [(0, dict(prompt=[5, 17, 3, 9], max_new_tokens=8))]
+    client = ServeClient(dec, params, num_slots=2, prefill_len=6,
+                         retry_policy=RetryPolicy(max_attempts=2,
+                                                  base_delay=0.0))
+    # fail a decode dispatch late enough that prompt(4) + emitted > 6
+    plan = FaultPlan.at("serve.dispatch", [4])
+    with plan.armed():
+        out = client.serve_trace(trace)
+    assert out[0].finish_reason == FINISH_FAILED
+    assert len(out[0].tokens) >= 3  # kept the work it had done
+
+
+# --------------------------------------------------------------------- #
+# trainer: kill + auto-resume
+# --------------------------------------------------------------------- #
+def _trainer(root, **kw):
+    kw.setdefault("strategy", RayStrategy(num_workers=1))
+    kw.setdefault("max_epochs", 3)
+    kw.setdefault("limit_train_batches", 4)
+    kw.setdefault("limit_val_batches", 2)
+    kw.setdefault("seed", 0)
+    return Trainer(default_root_dir=root, **kw)
+
+
+def _snap(tree):
+    """Deep-copied host snapshot: device_get on CPU hands back zero-copy
+    views of live buffers, which later donated train steps can overwrite
+    in place (docs/testing.md "donation aliasing") — copies or bust."""
+    return jax.tree_util.tree_map(np.array, jax.device_get(tree))
+
+
+def _params_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(_snap(a)),
+                    jax.tree_util.tree_leaves(_snap(b))):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_kill_and_auto_resume_matches_uninterrupted(tmp_root):
+    """PINNED: a train.step crash mid-epoch-1, then resume='auto' from
+    the epoch-end checkpoint -> bitwise-identical final params and the
+    same final eval loss as the run that never crashed."""
+    ref = _trainer(os.path.join(tmp_root, "ref"),
+                   enable_checkpointing=False)
+    ref.fit(BoringModel())
+    ref_params = _snap(ref.train_state.params)
+    ref_loss = float(ref.callback_metrics["x"])
+
+    ck = os.path.join(tmp_root, "ck")
+    killed = _trainer(tmp_root, callbacks=[ModelCheckpoint(dirpath=ck)])
+    with pytest.raises(InjectedFault):
+        with FaultPlan.at("train.step", [6]).armed():  # epoch 1, batch 2
+            killed.fit(BoringModel())
+
+    resumed = _trainer(tmp_root, callbacks=[ModelCheckpoint(dirpath=ck)],
+                       resume="auto")
+    resumed.fit(BoringModel())
+    _params_equal(ref_params, jax.device_get(resumed.train_state.params))
+    assert float(resumed.callback_metrics["x"]) == pytest.approx(
+        ref_loss, abs=0)
+    assert resumed.global_step == ref.global_step
+
+
+def test_mid_epoch_periodic_checkpoint_resume(tmp_root):
+    """every_n_train_steps checkpoints record their batch-in-epoch
+    position; resume re-enters the epoch and fast-forwards the loader,
+    reaching the same bitwise final state as the uninterrupted run."""
+    ref = _trainer(os.path.join(tmp_root, "ref"),
+                   enable_checkpointing=False)
+    ref.fit(BoringModel())
+    ref_params = _snap(ref.train_state.params)
+
+    ck = os.path.join(tmp_root, "ck")
+    cb = dict(dirpath=ck, every_n_train_steps=2, save_top_k=2)
+    killed = _trainer(tmp_root, callbacks=[ModelCheckpoint(**cb)])
+    with pytest.raises(InjectedFault):
+        with FaultPlan.at("train.step", [7]).armed():  # epoch 1, batch 3
+            killed.fit(BoringModel())
+    assert any("step=6" in n for n in os.listdir(ck))  # mid-epoch save
+
+    resumed = _trainer(tmp_root, callbacks=[ModelCheckpoint(**cb)],
+                       resume="auto")
+    resumed.fit(BoringModel())
+    _params_equal(ref_params, jax.device_get(resumed.train_state.params))
+
+
+def test_auto_resume_skips_corrupt_candidate(tmp_root):
+    """A ckpt.save fault kills the newest (orbax) save after its state
+    item committed but before the meta marker: resume='auto' must skip
+    the corpse with a warning and restore the older valid checkpoint."""
+    from ray_lightning_tpu.core.checkpoint import (CorruptCheckpointError,
+                                                   load_sharded_checkpoint)
+    ck = os.path.join(tmp_root, "ck")
+    cb = dict(dirpath=ck, save_format="orbax", save_top_k=-1)
+    t1 = _trainer(tmp_root, max_epochs=2,
+                  callbacks=[ModelCheckpoint(**cb)])
+    # first epoch-end save commits; the second is killed pre-marker
+    with pytest.raises(InjectedFault):
+        with FaultPlan.at("ckpt.save", [1]).armed():
+            t1.fit(BoringModel())
+    names = sorted(os.listdir(ck))
+    assert len(names) == 2
+    with pytest.raises(CorruptCheckpointError):
+        load_sharded_checkpoint(os.path.join(ck, names[1]))
+
+    t2 = _trainer(tmp_root, max_epochs=2,
+                  callbacks=[ModelCheckpoint(**cb)], resume="auto")
+    t2.fit(BoringModel())  # must not raise: falls back to epoch-0 ckpt
+    assert t2.current_epoch == 1
+
+
+def test_numpy_fallback_checkpoint_roundtrip_and_atomicity(tmp_root):
+    """The orbax-free directory format: byte-exact roundtrip, staged in a
+    tmp sibling, os.replace-committed — a mid-save kill leaves NOTHING
+    visible (no partial dir, no stray tmp in resume scans)."""
+    from ray_lightning_tpu.core.checkpoint import (CorruptCheckpointError,
+                                                   find_resume_candidates,
+                                                   load_sharded_checkpoint,
+                                                   save_sharded_checkpoint)
+    t = _trainer(tmp_root, max_epochs=1, limit_train_batches=2,
+                 limit_val_batches=0, enable_checkpointing=False)
+    t.fit(BoringModel())
+    ckpt = t.dump_checkpoint()
+    path = os.path.join(tmp_root, "np_ck")
+    save_sharded_checkpoint(path, ckpt, t.train_state, backend="numpy")
+    out = load_sharded_checkpoint(path)
+    assert out["global_step"] == 2
+    _params_equal(ckpt["state"]["params"], out["state"]["params"])
+
+    path2 = os.path.join(tmp_root, "np_ck2")
+    with pytest.raises(InjectedFault):
+        with FaultPlan.at("ckpt.save", [0]).armed():
+            save_sharded_checkpoint(path2, ckpt, t.train_state,
+                                    backend="numpy")
+    assert not os.path.exists(path2)
+    assert all("np_ck2" not in c
+               for c in find_resume_candidates(tmp_root))
+
+    # truncated payload reads as corrupt, not as a bare msgpack error
+    bad = os.path.join(tmp_root, "bad_ck")
+    os.makedirs(bad)
+    for name in ("np_state.msgpack", "tl_meta.msgpack"):
+        with open(os.path.join(bad, name), "wb") as f:
+            f.write(b"\x93truncated")
+    with pytest.raises(CorruptCheckpointError):
+        load_sharded_checkpoint(bad)
+
+
+def test_periodic_saves_do_not_hijack_monitored_best(tmp_root):
+    """every_n_train_steps + a monitored ModelCheckpoint: periodic saves
+    roll (only the newest survives) and never enter best-model tracking
+    or top-k — a recency score of -global_step would beat any real
+    mode='min' metric and repoint best_model_path at an unmonitored
+    crash-safety snapshot."""
+    ck = os.path.join(tmp_root, "ck")
+    cb = ModelCheckpoint(dirpath=ck, monitor="x", mode="min",
+                         save_top_k=1, every_n_train_steps=2)
+    t = _trainer(tmp_root, max_epochs=2, callbacks=[cb])
+    t.fit(BoringModel())
+    assert "x=" in os.path.basename(cb.best_model_path)
+    assert cb.best_model_score is not None and cb.best_model_score > 0
+    periodic = [n for n in os.listdir(ck) if "x=" not in n]
+    assert len(periodic) == 1  # rolling: older periodic saves deleted
+    assert "step=8" in periodic[0]
+
+
+def test_nonfinite_guard_actions(tmp_root):
+    """loader.next NaN-poison: 'skip_batch' drops the update and keeps
+    training (weights stay finite), 'raise' fails fast, and
+    'restore_last_ckpt' rolls back to the newest periodic checkpoint."""
+    def run(action, subdir, fault_tick=1, callbacks=()):
+        t = _trainer(os.path.join(tmp_root, subdir), max_epochs=2,
+                     limit_val_batches=0, nonfinite_action=action,
+                     callbacks=list(callbacks),
+                     enable_checkpointing=bool(callbacks))
+        with FaultPlan.at("loader.next", [fault_tick],
+                          mode="nan").armed():
+            t.fit(BoringModel())
+        return t
+
+    t = run("skip_batch", "skip")
+    assert t.nonfinite_batches == 1 and t.nonfinite_restores == 0
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in
+               jax.tree_util.tree_leaves(
+                   jax.device_get(t.train_state.params)))
+
+    with pytest.raises(NonFiniteError):
+        run("raise", "raise")
+
+    ck = os.path.join(tmp_root, "restore", "ck")
+    t = run("restore_last_ckpt", "restore", fault_tick=2,
+            callbacks=[ModelCheckpoint(dirpath=ck, every_n_train_steps=1)])
+    assert t.nonfinite_batches == 1 and t.nonfinite_restores == 1
+
+    # restore with no checkpoint yet: fails loudly, not silently
+    with pytest.raises(NonFiniteError, match="no checkpoint"):
+        run("restore_last_ckpt", "restore_none")
+
+
+def test_fit_supervisor_retries_to_completion(tmp_root):
+    """One injected train.step crash: attempt 1 dies, attempt 2 resumes
+    from the epoch-end checkpoint and finishes — same final state as an
+    uninterrupted run."""
+    ref = _trainer(os.path.join(tmp_root, "ref"), limit_val_batches=0,
+                   enable_checkpointing=False)
+    ref.fit(BoringModel())
+    ck = os.path.join(tmp_root, "ck")
+
+    def make_trainer():
+        return _trainer(tmp_root, limit_val_batches=0,
+                        callbacks=[ModelCheckpoint(dirpath=ck)])
+
+    sup = FitSupervisor(make_trainer,
+                        RetryPolicy(max_attempts=3, base_delay=0.0),
+                        sleep=lambda s: None)
+    with FaultPlan.at("train.step", [5]).armed():
+        trainer = sup.fit(BoringModel)  # factory: fresh module per try
+    assert sup.attempts == 2
+    assert trainer.state == "finished"
+    _params_equal(jax.device_get(ref.train_state.params),
+                  jax.device_get(trainer.train_state.params))
+
+
+def test_serve_supervisor_delegates_engine_surface(nano):
+    """The supervisor quacks like the engine for the scheduler/bench
+    probes, and swaps in a fresh engine object across a rebuild."""
+    dec, params = nano
+    sup = ServeSupervisor(dec, params, num_slots=2, prefill_len=8,
+                          policy=RetryPolicy(max_attempts=1))
+    assert sup.free_slots == 2 and sup.active_count == 0
+    first_engine = sup.engine
+    with FaultPlan.at("serve.dispatch", [0]).armed():
+        from ray_lightning_tpu.serve import Request
+        out = sup.prefill([Request(id=0, prompt=[3, 1], max_new_tokens=2)])
+    # max_attempts=1 -> replay once; the request survives via replay
+    assert sup.engine is not first_engine
+    assert sup.rebuilds == 1 and out == []
+    assert sup.active_count == 1
