@@ -45,6 +45,7 @@ from ray_lightning_tpu.reliability import (FaultPlan, FitSupervisor,
                                            InjectedFault, NonFiniteError,
                                            RetriesExhausted, RetryPolicy,
                                            ServeSupervisor)
+from ray_lightning_tpu.obs import StepStatsCallback, Telemetry
 
 __version__ = "0.2.0"
 
@@ -58,4 +59,5 @@ __all__ = [
     "RayLauncher", "LocalLauncher",
     "FaultPlan", "FitSupervisor", "InjectedFault", "NonFiniteError",
     "RetriesExhausted", "RetryPolicy", "ServeSupervisor",
+    "StepStatsCallback", "Telemetry",
 ]

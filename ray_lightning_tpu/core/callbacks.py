@@ -356,7 +356,7 @@ class EarlyStopping(Callback):
             trainer.should_stop = True
             self.stopped_epoch = trainer.current_epoch
             if self.verbose and trainer.global_rank == 0:
-                print(f"EarlyStopping: {self.monitor} did not improve for "
+                print(f"EarlyStopping: {self.monitor} did not improve for "  # tl-lint: allow-print — verbose=True console UI
                       f"{self.wait_count} checks (best "
                       f"{self.best_score:.6f}); stopping at epoch "
                       f"{self.stopped_epoch}.")
@@ -426,7 +426,7 @@ class EpochStatsCallback(Callback):
         peak = float(np.mean(peaks)) if peaks else 0.0
         self.peak_memory_mib.append(peak)
         if self.print_stats and trainer.global_rank == 0:
-            print(f"Epoch {trainer.current_epoch}: {dt:.2f}s, "
+            print(f"Epoch {trainer.current_epoch}: {dt:.2f}s, "  # tl-lint: allow-print — print_stats=True console UI
                   f"avg peak HBM {peak:.0f} MiB")
 
 

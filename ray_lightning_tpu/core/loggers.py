@@ -70,7 +70,12 @@ class CSVLogger(Callback):
         }
         for k, v in trainer.callback_metrics.items():
             if hasattr(v, "__float__") or np.isscalar(v):
-                row[k] = float(v)
+                # np.isscalar("abc") is True — a string metric (e.g. a
+                # status tag) must be skipped, not crash the epoch
+                try:
+                    row[k] = float(v)
+                except (TypeError, ValueError):
+                    continue
         self._write(row)
 
     def _write(self, row: Dict[str, Any]) -> None:
@@ -115,32 +120,40 @@ class JaxProfilerCallback(Callback):
         self.num_steps = num_steps
         self.log_dir = log_dir
         self._active = False
+        self._done = False          # one window per callback instance
+        self._started_at: Optional[int] = None
         self.trace_dir: Optional[str] = None
 
     def on_train_batch_start(self, trainer, pl_module, batch,
                              batch_idx: int) -> None:
-        if trainer.global_rank != 0 or self._active:
+        if trainer.global_rank != 0 or self._active or self._done:
             return
-        if trainer.global_step == self.start_step:
+        # >= (not ==): a run resumed PAST start_step must still profile —
+        # with == the window is silently skipped forever. The window then
+        # covers num_steps from wherever tracing actually started.
+        if trainer.global_step >= self.start_step:
             import jax
             self.trace_dir = self.log_dir or os.path.join(
                 trainer.default_root_dir, "profile")
             os.makedirs(self.trace_dir, exist_ok=True)
             jax.profiler.start_trace(self.trace_dir)
             self._active = True
+            self._started_at = trainer.global_step
 
     def on_train_batch_end(self, trainer, pl_module, outputs, batch,
                            batch_idx: int) -> None:
         if not self._active:
             return
-        if trainer.global_step >= self.start_step + self.num_steps:
+        if trainer.global_step >= self._started_at + self.num_steps:
             import jax
             trainer.block_until_ready()
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
 
     def teardown(self, trainer, pl_module, stage: str) -> None:
         if self._active:  # trace window larger than the run: close cleanly
             import jax
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True

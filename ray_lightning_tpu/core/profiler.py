@@ -41,6 +41,12 @@ class PassThroughProfiler:
     def reset(self) -> None:
         pass
 
+    def records(self) -> Dict[str, Tuple[int, float]]:
+        """``{section: (calls, total_seconds)}`` — the machine-readable
+        view the trainer exports into the telemetry metrics registry at
+        fit end (``profile_<section>_s`` gauges)."""
+        return {}
+
 
 class SimpleProfiler(PassThroughProfiler):
     """Accumulate wall-clock per named section (scoped per fit: the
@@ -62,6 +68,9 @@ class SimpleProfiler(PassThroughProfiler):
             dt = time.perf_counter() - t0
             count, total = self._records.get(name, (0, 0.0))
             self._records[name] = (count + 1, total + dt)
+
+    def records(self) -> Dict[str, Tuple[int, float]]:
+        return dict(self._records)
 
     def profile_iterable(self, iterable, name: str):
         """Time each ``next()`` — the data-wait measurement."""

@@ -77,7 +77,8 @@ class Trainer:
                  profiler=None,
                  seed: Optional[int] = None,
                  resume: Optional[str] = None,
-                 nonfinite_action: Optional[str] = None):
+                 nonfinite_action: Optional[str] = None,
+                 telemetry=None):
         from ray_lightning_tpu.strategies.ddp import RayStrategy
         self.strategy = strategy if strategy is not None else RayStrategy(
             num_workers=1)
@@ -137,6 +138,10 @@ class Trainer:
         self.nonfinite_action = nonfinite_action
         self.nonfinite_batches = 0   # guarded steps that came back bad
         self.nonfinite_restores = 0  # times restore_last_ckpt fired
+        # obs.Telemetry handle (None = disarmed): the trainer emits
+        # fit/epoch/worker lifecycle events; per-step stats are the
+        # opt-in StepStatsCallback's job so the hot loop stays untouched
+        self.telemetry = telemetry
 
         if self.enable_checkpointing and not any(
                 isinstance(cb, ModelCheckpoint) for cb in self.callbacks):
@@ -492,9 +497,23 @@ class Trainer:
         for cb in self.callbacks:
             cb.on_train_start(self, module)
 
+        tel = self.telemetry
+        if tel is not None:
+            tel.event("worker.start", rank=self.global_rank,
+                      world_size=self.world_size,
+                      num_devices=self.num_devices)
+            tel.event("fit.start", max_epochs=self.max_epochs,
+                      max_steps=self.max_steps,
+                      start_epoch=start_epoch,
+                      global_step=self.global_step,
+                      resumed=restored_ckpt is not None)
+
         stop = False
         for epoch in range(start_epoch, self.max_epochs):
             self.current_epoch = epoch
+            if tel is not None:
+                tel.event("epoch.start", epoch=epoch,
+                          global_step=self.global_step)
             if hasattr(train_loader, "set_epoch"):
                 train_loader.set_epoch(epoch)
             module.on_train_epoch_start()
@@ -598,7 +617,7 @@ class Trainer:
                 dt = time.perf_counter() - t0
                 msg = ", ".join(f"{k}={v:.4f}" for k, v in agg.items()
                                 if np.isscalar(v))
-                print(f"epoch {epoch}: {msg} ({dt:.1f}s)")
+                print(f"epoch {epoch}: {msg} ({dt:.1f}s)")  # tl-lint: allow-print — enable_progress_bar console UI
 
             # `self.should_stop` too: a mid-epoch interval validation may
             # have tripped EarlyStopping after the batch loop broke —
@@ -621,6 +640,9 @@ class Trainer:
             with self.profiler.profile("epoch_end_callbacks"):
                 for cb in self.callbacks:
                     cb.on_train_epoch_end(self, module)
+            if tel is not None:
+                tel.event("epoch.end", epoch=epoch,
+                          global_step=self.global_step)
             if stop or self.should_stop:
                 break
 
@@ -636,6 +658,24 @@ class Trainer:
 
         from ray_lightning_tpu.core.checkpoint import wait_for_async_saves
         wait_for_async_saves()
+        if tel is not None:
+            tel.event("fit.end", epoch=self.current_epoch,
+                      global_step=self.global_step,
+                      stopped_early=self.should_stop)
+            # profiler sections (when one is armed) become gauges, so
+            # the wall-clock breakdown is scrapeable, not just printable
+            for name, (count, total) in getattr(
+                    self.profiler, "records", dict)().items():
+                tel.metrics.gauge(
+                    f"profile_{name}_s",
+                    help="SimpleProfiler section total (s)").set(total)
+            # in-process launches only: under a remote launcher this
+            # trainer is a worker-side COPY, and a flush here would
+            # atomically overwrite a shared jsonl_path with only this
+            # rank's events, clobbering the driver's log (the driver
+            # flushes its own handle after launch.done)
+            if not self.strategy.is_remote:
+                tel.flush()
         if self.strategy.global_rank == 0:
             self.profiler.describe()
         return self._collect_rank_zero_results()

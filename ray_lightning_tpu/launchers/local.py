@@ -43,6 +43,11 @@ class LocalLauncher:
             self._strategy.init_hook()
         _session.shutdown_session()
         _session.init_session(rank=0, queue=self.queue)
+        tel = getattr(trainer, "telemetry", None)
+        if tel is not None:
+            tel.event("launch.start", launcher="local",
+                      num_workers=getattr(self._strategy, "num_workers",
+                                          1))
         try:
             result = function(*args, **kwargs)
         finally:
@@ -52,6 +57,8 @@ class LocalLauncher:
             # and the ring-attention mesh registration (meshes rebuild
             # lazily on the next use, so this is cleanup, not state loss)
             self._strategy.teardown()
+            if tel is not None:
+                tel.event("launch.done", launcher="local")
         return result
 
     def drain_queue(self) -> None:

@@ -184,6 +184,14 @@ class RayLauncher:
     def launch(self, function: Callable, *args: Any, trainer=None,
                **kwargs: Any) -> Any:
         """Parity: ``ray_launcher.py:48-69``."""
+        # driver-side lifecycle events only: the telemetry handle's ring
+        # and sink live in this process, worker-side events come back as
+        # callback_metrics (the existing rank-0 transport)
+        tel = getattr(trainer, "telemetry", None)
+        if tel is not None:
+            tel.event("launch.start", launcher="ray",
+                      num_workers=getattr(self._strategy, "num_workers",
+                                          1))
         self.setup_workers()
         try:
             output = self.run_function_on_workers(
@@ -191,6 +199,9 @@ class RayLauncher:
         finally:
             self.teardown_workers()
             self._strategy.teardown()
+            if tel is not None:
+                tel.event("launch.done", launcher="ray")
+                tel.flush()  # the driver owns the jsonl segment
         return output
 
     def setup_workers(self, tune_enabled: bool = True) -> None:

@@ -38,11 +38,22 @@ def log_suppressed(site: str, exc: BaseException, detail: str = "") -> None:
 
     The package-wide lint (``tests/test_lint_exceptions.py``) rejects
     ``except Exception:`` blocks that neither re-raise nor call this —
-    every broad catch must leave a trace an operator can find.
+    every broad catch must leave a trace an operator can find. With a
+    :class:`~ray_lightning_tpu.obs.Telemetry` handle activated, every
+    suppression additionally lands on the event bus (site
+    ``log.suppressed``) so chaos runs are observable, not just survivable.
     """
     logger.warning("suppressed at %s: %s: %s%s", site,
                    type(exc).__name__, exc,
                    f" ({detail})" if detail else "")
+    from ray_lightning_tpu.obs import emit_global, get_global
+    emit_global("log.suppressed", site=site, exc=type(exc).__name__,
+                detail=detail)
+    tel = get_global()
+    if tel is not None:
+        tel.metrics.counter(
+            "reliability_suppressed_total",
+            help="exceptions swallowed via log_suppressed").inc()
 
 
 from ray_lightning_tpu.reliability.faults import (  # noqa: E402

@@ -184,6 +184,16 @@ class FaultPlan:
             return None
         self.fired += 1
         logger.warning("injecting %s at %s tick %d", spec.mode, site, tick)
+        # chaos is observable, not just survivable: injections land on
+        # the activated telemetry's event bus (no-op without one)
+        from ray_lightning_tpu import obs
+        obs.emit_global("fault.injected", site=site, tick=tick,
+                        mode=spec.mode)
+        tel = obs.get_global()
+        if tel is not None:
+            tel.metrics.counter(
+                "reliability_faults_total",
+                help="faults injected by the armed FaultPlan").inc()
         if spec.mode == MODE_RAISE:
             raise InjectedFault(site, tick)
         if spec.mode == MODE_STALL:

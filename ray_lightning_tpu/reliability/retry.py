@@ -97,15 +97,32 @@ def call_with_retry(fn: Callable[[int], T], policy: RetryPolicy, *,
     ``sleep``/``clock`` are injectable so tests retry instantly and
     assert the exact backoff schedule.
     """
+    from ray_lightning_tpu import obs
     t0 = clock()
     for attempt in range(1, policy.max_attempts + 1):
+        # every attempt (including the first) is an event: a chaos run's
+        # log shows the full retry ladder, not just the failures. The
+        # None check comes BEFORE any kwargs build — the disarmed path
+        # stays allocation-free (the FaultPlan contract).
+        tel = obs.get_global()
+        if tel is not None:
+            tel.bus.emit("retry.attempt", site=site, attempt=attempt,
+                         max_attempts=policy.max_attempts)
         try:
             return fn(attempt)
         except Exception as exc:  # noqa: BLE001 — re-raised on exhaustion
             out_of_time = (policy.deadline is not None
                            and clock() - t0 >= policy.deadline)
             if attempt >= policy.max_attempts or out_of_time:
+                if tel is not None:
+                    tel.bus.emit("retry.exhausted", site=site,
+                                 attempts=attempt,
+                                 exc=type(exc).__name__)
                 raise RetriesExhausted(attempt, exc) from exc
+            if tel is not None:
+                tel.metrics.counter(
+                    "reliability_retries_total",
+                    help="failed attempts that scheduled a retry").inc()
             logger.warning(
                 "%s: attempt %d/%d failed (%s: %s); retrying in %.3fs",
                 site, attempt, policy.max_attempts, type(exc).__name__,

@@ -61,6 +61,9 @@ class ServeSupervisor:
         self._engine_kwargs = dict(engine_kwargs)
         self._sleep = sleep
         self.engine = ServeEngine(model, params, **engine_kwargs)
+        # the same handle the engine got (rebuilt engines inherit it via
+        # engine_kwargs); None = disarmed, nothing below allocates
+        self._tel = self._engine_kwargs.get("telemetry")
         self.rebuilds = 0
         self.recoveries = 0
         self.failed_requests = 0
@@ -128,9 +131,23 @@ class ServeSupervisor:
             logger.error(
                 "serve recovery exhausted (%s); retiring %d request(s) "
                 "as failed", exc, len(entries))
+            if self._tel is not None:
+                self._tel.event("recovery.exhausted",
+                                failed=len(entries),
+                                attempts=exc.attempts)
             self.engine = self._engine_cls(self._model, self._params,
                                            **self._engine_kwargs)
             self.rebuilds += 1
+            if self._tel is not None:
+                # the clean-slate rebuild is a rebuild too: keep the
+                # event log and reliability_rebuilds_total in lockstep
+                # with the supervisor's own `rebuilds` counter
+                self._tel.event("engine.rebuild", rebuilds=self.rebuilds,
+                                in_flight=0)
+                self._tel.metrics.counter(
+                    "reliability_rebuilds_total",
+                    help="serve engines rebuilt after a dispatch crash"
+                ).inc()
             self.failed_requests += len(entries)
             self.recovery_s_total += time.perf_counter() - t0
             return [
@@ -146,6 +163,16 @@ class ServeSupervisor:
         self.engine = self._engine_cls(self._model, self._params,
                                        **self._engine_kwargs)
         self.rebuilds += 1
+        tel = self._tel
+        if tel is not None:
+            tel.event("engine.rebuild", rebuilds=self.rebuilds,
+                      in_flight=len(entries))
+            tel.metrics.counter(
+                "reliability_rebuilds_total",
+                help="serve engines rebuilt after a dispatch crash").inc()
+            for req, toks in entries:
+                tel.event("recovery.replay", id=req.id,
+                          replayed_tokens=len(toks))
         done: List[Completion] = []
         pending: List[Request] = []
         for req, toks in entries:

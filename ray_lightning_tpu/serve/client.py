@@ -45,11 +45,12 @@ class ServeClient:
                  scheduler_config: Optional[SchedulerConfig] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
-                 retry_policy=None):
+                 retry_policy=None, telemetry=None):
         engine_kwargs = dict(
             num_slots=num_slots, prefill_batch=prefill_batch,
             prefill_len=prefill_len,
-            steps_per_dispatch=steps_per_dispatch, seed=seed)
+            steps_per_dispatch=steps_per_dispatch, seed=seed,
+            telemetry=telemetry)
         if retry_policy is not None:
             # supervised engine: dispatch crashes rebuild + replay under
             # the policy instead of unwinding through the client loop;
@@ -66,6 +67,11 @@ class ServeClient:
         self._ops = 0  # engine dispatches so far = the tick clock
         self._next_id = 0
         self.completions: Dict[int, Completion] = {}
+        # telemetry is off by default: every armed emission below sits
+        # behind `if tel is not None` — the disarmed loop pays one
+        # attribute read + None check per tick, nothing else
+        self._tel = telemetry
+        self.num_slots = num_slots
 
     # ------------------------------------------------------------ clock
     @property
@@ -96,6 +102,18 @@ class ServeClient:
         self.scheduler.submit(req, now)
         req.arrival_time = now
         self._next_id += 1
+        tel = self._tel
+        if tel is not None:
+            tel.event("serve.submit", id=req.id,
+                      prompt_len=req.prompt_len,
+                      max_new_tokens=req.max_new_tokens, t=now)
+            tel.metrics.counter(
+                "serve_requests_total",
+                help="requests accepted by admission control").inc()
+            tel.metrics.gauge(
+                "serve_queue_depth",
+                help="requests waiting in the scheduler queue"
+            ).set(len(self.scheduler))
         return req.id
 
     # ------------------------------------------------------------- loop
@@ -132,11 +150,19 @@ class ServeClient:
             if deferred:
                 self.scheduler.requeue_front(deferred)
             if admit:
+                tel = self._tel
+                if tel is not None:
+                    for req in admit:
+                        tel.event("serve.admit", id=req.id,
+                                  queue_wait=now - req.arrival_time)
                 done.extend(self.engine.prefill(admit))
                 self._ops += 1  # count the dispatch before stamping TTFT
                 t_first = self.now()
                 for req in admit:
                     req.first_token_time = t_first
+                    if tel is not None:
+                        tel.event("serve.first_token", id=req.id,
+                                  ttft=t_first - req.arrival_time)
             elif self.engine.active_count:
                 done.extend(self.engine.step())
                 self._ops += 1
@@ -155,7 +181,52 @@ class ServeClient:
                 # stamping loop ran for it
                 comp.first_token_time = t_done
             self.completions[comp.request_id] = comp
+        tel = self._tel
+        if tel is not None:
+            self._record_retirements(tel, done)
         return done
+
+    def _record_retirements(self, tel, done: List[Completion]) -> None:
+        """Armed-path bookkeeping for one tick: retire events + the
+        vLLM-style request lifecycle metrics (TTFT / TPOT / end-to-end
+        latency histograms, queue-depth and slot-occupancy gauges). All
+        times are in the client's clock units (ticks or seconds)."""
+        m = tel.metrics
+        for comp in done:
+            tel.event("serve.retire", id=comp.request_id,
+                      finish_reason=comp.finish_reason,
+                      tokens=len(comp.tokens))
+            m.counter("serve_completions_total",
+                      help="requests retired, any finish reason").inc()
+            m.counter(f"serve_finish_{comp.finish_reason}_total",
+                      help=f"requests retired with finish_reason="
+                      f"{comp.finish_reason}").inc()
+            m.counter("serve_tokens_total",
+                      help="generated tokens across all requests"
+                      ).inc(len(comp.tokens))
+            if comp.latency is not None:
+                m.histogram("serve_latency",
+                            help="arrival -> completion (client clock "
+                            "units)").observe(comp.latency)
+            ttft = comp.time_to_first_token
+            if ttft is not None:
+                m.histogram("serve_ttft",
+                            help="arrival -> first token (client clock "
+                            "units)").observe(ttft)
+                if (len(comp.tokens) > 1
+                        and comp.finish_time is not None):
+                    m.histogram(
+                        "serve_tpot",
+                        help="per-token decode time after the first "
+                        "(client clock units)").observe(
+                        (comp.finish_time - comp.first_token_time)
+                        / (len(comp.tokens) - 1))
+        m.gauge("serve_queue_depth",
+                help="requests waiting in the scheduler queue"
+                ).set(len(self.scheduler))
+        m.gauge("serve_slot_occupancy",
+                help="fraction of KV slots holding an in-flight request"
+                ).set(self.engine.active_count / self.num_slots)
 
     def run_until_idle(self, max_ticks: int = 100_000) \
             -> Dict[int, Completion]:
@@ -194,7 +265,7 @@ class ServeClient:
                 kwargs = pending[idx][1]
                 try:
                     self.submit(**kwargs)
-                except (QueueFull, ValueError):
+                except (QueueFull, ValueError) as exc:
                     rid = self._next_id
                     self._next_id += 1
                     self.completions[rid] = Completion(
@@ -202,6 +273,12 @@ class ServeClient:
                         prompt=[int(t) for t in kwargs.get("prompt", [])],
                         tokens=[], finish_reason=FINISH_REJECTED,
                         arrival_time=now, finish_time=now)
+                    if self._tel is not None:
+                        self._tel.event("serve.reject", id=rid,
+                                        why=type(exc).__name__)
+                        self._tel.metrics.counter(
+                            "serve_rejected_total",
+                            help="requests shed at admission").inc()
                 idx += 1
             if (idx < len(pending) and not len(self.scheduler)
                     and not self.engine.active_count):

@@ -45,6 +45,7 @@ import numpy as np
 from ray_lightning_tpu.models.generate import (_prefill_impl, decode_step,
                                                sample_logits_rows)
 from ray_lightning_tpu.models.transformer import latch_eos
+from ray_lightning_tpu.obs.spans import NULL_SPAN
 from ray_lightning_tpu.reliability import faults
 from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_LENGTH, FINISH_TIMEOUT,
@@ -268,7 +269,7 @@ class ServeEngine:
     def __init__(self, model, params, *, num_slots: int = 8,
                  prefill_batch: Optional[int] = None,
                  prefill_len: int = 64, steps_per_dispatch: int = 1,
-                 seed: int = 0):
+                 seed: int = 0, telemetry=None):
         cfg = model.cfg
         if not cfg.decode:
             raise ValueError(
@@ -294,6 +295,9 @@ class ServeEngine:
         # (amortizes the fixed per-call overhead; requests join/retire at
         # K-token granularity) — see _engine_step_impl
         self.steps_per_dispatch = steps_per_dispatch
+        # off by default; one attribute read + None check per dispatch
+        # when disarmed (docs/observability.md)
+        self._tel = telemetry
         self.pool = KVSlotPool(model, num_slots)
         self._base_key = jax.random.PRNGKey(seed)
 
@@ -405,11 +409,18 @@ class ServeEngine:
         for r in range(len(requests), B_pf):
             slots[r] = acquired[0]
 
+        tel = self._tel
         fn = _pick(_prefill_inject_donated, _prefill_inject_plain)
-        self.pool.cache, first = fn(
-            self.model, self.params, self.pool.cache, prompts, lengths,
-            slots, valid, keys, temp, top_k, startno)
-        first = np.asarray(first)
+        with (tel.span("engine.prefill", n=len(requests))
+              if tel is not None else NULL_SPAN):
+            self.pool.cache, first = fn(
+                self.model, self.params, self.pool.cache, prompts,
+                lengths, slots, valid, keys, temp, top_k, startno)
+            first = np.asarray(first)
+        if tel is not None:
+            tel.event("engine.prefill", n=len(requests),
+                      ids=[r.id for r in requests],
+                      slots=[int(s) for s in acquired])
 
         done: List[Completion] = []
         for r, req in enumerate(requests):
@@ -443,13 +454,16 @@ class ServeEngine:
         if not self._active.any():
             return []
         faults.fire("serve.dispatch")
+        tel = self._tel
         fn = _pick(_engine_step_donated, _engine_step_plain)
-        (self.pool.cache, cur, pos, active, remaining, stepno, emitted,
-         finished) = fn(
-            self.model, self.params, self.pool.cache, self._cur,
-            self._pos, self._active, self._remaining, self._temp,
-            self._top_k, self._eos, self._keys, self._stepno,
-            steps=self.steps_per_dispatch)
+        with (tel.span("engine.step", active=int(self._active.sum()))
+              if tel is not None else NULL_SPAN):
+            (self.pool.cache, cur, pos, active, remaining, stepno,
+             emitted, finished) = fn(
+                self.model, self.params, self.pool.cache, self._cur,
+                self._pos, self._active, self._remaining, self._temp,
+                self._top_k, self._eos, self._keys, self._stepno,
+                steps=self.steps_per_dispatch)
         # np.array (copy): jax outputs view as read-only buffers, and the
         # next prefill writes these rows in place
         self._cur = np.array(cur)
@@ -474,6 +488,9 @@ class ServeEngine:
                     slot, FINISH_EOS if hit_eos else FINISH_LENGTH))
         self.steps += 1
         self.decode_substeps += self.steps_per_dispatch
+        if tel is not None:
+            tel.event("engine.step", dispatch=self.steps,
+                      active=self.active_count, retired=len(done))
         return done
 
     # -------------------------------------------------------- lifecycle

@@ -1,0 +1,64 @@
+"""AST lint: library code contains no bare ``print()``.
+
+Sibling of ``test_lint_exceptions.py`` / ``test_lint_unreachable.py``.
+With the obs layer in place (PR 4), telemetry and ``logging`` are the
+sanctioned output channels for library code — a stray ``print`` is
+invisible to operators (no level, no routing, no structure) and pollutes
+stdout for programs embedding the package. Allowed seats:
+
+- ``cli.py`` — the CLI's job *is* stdout;
+- any function named ``describe`` — the profiler-report convention
+  (``SimpleProfiler.describe`` prints a human table on request);
+- an explicit ``tl-lint: allow-print`` marker on the call line with a
+  justification — reserved for *opt-in* console UI the user explicitly
+  asked for (``enable_progress_bar``, ``verbose=True`` flags).
+
+``examples/`` and ``tools/`` live outside the package and are not
+linted. Docstring examples don't count (strings, not calls).
+"""
+import ast
+import pathlib
+
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "ray_lightning_tpu"
+
+MARKER = "tl-lint: allow-print"
+
+
+def _print_calls(tree):
+    """(node, inside_describe) for every ``print(...)`` call."""
+    out = []
+
+    def visit(node, in_describe):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_describe = node.name == "describe"
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "print":
+            out.append((node, in_describe))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_describe)
+
+    visit(tree, False)
+    return out
+
+
+@pytest.mark.parametrize(
+    "path", sorted(PKG.rglob("*.py")), ids=lambda p: str(p.relative_to(PKG)))
+def test_no_bare_print_in_library_code(path):
+    if path.name == "cli.py":
+        pytest.skip("the CLI's job is stdout")
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    offenders = [
+        f"{path.relative_to(PKG.parent)}:{node.lineno}"
+        for node, in_describe in _print_calls(tree)
+        if not in_describe and MARKER not in lines[node.lineno - 1]
+    ]
+    assert not offenders, (
+        "bare print() in library code — route through telemetry "
+        "(obs.Telemetry) or logging, move it into a describe() report, "
+        f"or mark opt-in console UI with `# {MARKER} — <why>`: "
+        + ", ".join(offenders))

@@ -64,6 +64,26 @@ def test_csv_logger_extends_header_for_late_metrics(tmp_root):
     assert "x" in rows[1]  # BoringModel validation metric, epoch 1 only
 
 
+def test_csv_logger_skips_non_numeric_scalar_metrics(tmp_root):
+    """REGRESSION (ISSUE 4 satellite): ``np.isscalar("abc")`` is True,
+    so a string metric used to hit ``float("abc")`` and crash the epoch
+    end. Non-convertible values are skipped; numeric ones still land."""
+    import types
+    logger = CSVLogger(save_dir=tmp_root)
+    trainer = types.SimpleNamespace(
+        global_rank=0, default_root_dir=tmp_root, current_epoch=0,
+        global_step=3,
+        callback_metrics={"loss": 1.5, "status": "diverged",
+                          "acc": np.float32(0.25)})
+    logger.setup(trainer, None, "fit")
+    logger.on_train_epoch_end(trainer, None)  # must not raise
+    with open(os.path.join(logger.log_dir, "metrics.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert float(rows[0]["loss"]) == 1.5
+    assert float(rows[0]["acc"]) == 0.25
+    assert "status" not in rows[0]
+
+
 # --------------------------------------------------------------------- #
 # JaxProfilerCallback
 # --------------------------------------------------------------------- #
@@ -83,6 +103,43 @@ def test_profiler_window_past_end_closes_cleanly(tmp_root):
     cb = JaxProfilerCallback(start_step=2, num_steps=100)
     _fit(tmp_root, [cb], max_epochs=1)
     assert not cb._active  # teardown stopped the dangling trace
+
+
+def test_profiler_starts_when_resumed_past_start_step(tmp_root,
+                                                      monkeypatch):
+    """REGRESSION (ISSUE 4 satellite): a run resumed past ``start_step``
+    (global_step > start_step on the first batch) used to never start —
+    the old ``==`` comparison missed the window. ``>=`` with the
+    ``_done`` latch starts the trace immediately, covers ``num_steps``
+    from the actual start, and never restarts."""
+    import types
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    cb = JaxProfilerCallback(start_step=5, num_steps=2)
+    trainer = types.SimpleNamespace(global_rank=0, global_step=10,
+                                    default_root_dir=tmp_root,
+                                    block_until_ready=lambda: None)
+    cb.on_train_batch_start(trainer, None, None, 0)
+    assert cb._active and calls[0][0] == "start"
+    trainer.global_step = 11
+    cb.on_train_batch_end(trainer, None, None, None, 0)
+    assert cb._active               # 11 < 10 (actual start) + 2
+    cb.on_train_batch_start(trainer, None, None, 1)
+    trainer.global_step = 12
+    cb.on_train_batch_end(trainer, None, None, None, 1)
+    assert not cb._active and cb._done
+    assert calls[-1] == ("stop",)
+    # the window fired once; later steps must not reopen it
+    cb.on_train_batch_start(trainer, None, None, 2)
+    assert not cb._active
+    assert sum(1 for c in calls if c[0] == "start") == 1
+    # teardown after a completed window is a no-op (no double stop)
+    cb.teardown(trainer, None, "fit")
+    assert sum(1 for c in calls if c[0] == "stop") == 1
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,614 @@
+"""Unified telemetry layer (PR 4): events, metrics, spans, step stats,
+and the end-to-end instrumentation contracts.
+
+The load-bearing assertions (ISSUE 4 acceptance):
+
+- a tick-clock ``serve_trace`` under a pinned ``FaultPlan`` writes a
+  BYTE-IDENTICAL JSONL event log across two fresh runs (events carry no
+  wall time under the tick clock);
+- a chaos run's event log contains the injected fault, each retry
+  attempt, the engine rebuild, and per-request replay events IN ORDER;
+- the Chrome trace-event export loads as valid JSON with correctly
+  nested spans (child strictly inside parent).
+"""
+import json
+import os
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.obs import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge,
+                                   Histogram, MetricsRegistry, SpanRecorder,
+                                   StepStatsCallback, Telemetry, emit_global,
+                                   get_global, log_buckets)
+from ray_lightning_tpu.obs.events import EventBus, JsonlSink
+from ray_lightning_tpu.reliability import FaultPlan, RetryPolicy
+from ray_lightning_tpu.serve import ServeClient
+
+
+# --------------------------------------------------------------------- #
+# event bus
+# --------------------------------------------------------------------- #
+def test_event_bus_ring_and_ticks():
+    bus = EventBus(capacity=3)
+    for i in range(5):
+        bus.emit("a.site", i=i)
+    evs = bus.events()
+    assert [e.payload["i"] for e in evs] == [2, 3, 4]  # bounded ring
+    assert [e.tick for e in evs] == [2, 3, 4]          # ticks keep counting
+    assert bus.tick == 5
+
+
+def test_event_bus_site_filter():
+    bus = EventBus()
+    bus.emit("serve.submit", id=0)
+    bus.emit("serve.retire", id=0)
+    bus.emit("fault.injected")
+    assert len(bus.events("serve.submit")) == 1
+    assert len(bus.events("serve.")) == 2     # prefix filter
+    assert len(bus.events()) == 3
+
+
+def test_event_tick_clock_has_no_wall_time():
+    bus = EventBus()  # clock=None: deterministic tick mode
+    ev = bus.emit("x")
+    assert ev.wall_ms is None
+    assert "wall_ms" not in json.loads(ev.to_json())
+
+    t = [0.0]
+    wall = EventBus(clock=lambda: t[0])
+    wall.emit("x")
+    t[0] = 0.25
+    ev2 = wall.emit("y")
+    assert ev2.wall_ms == pytest.approx(250.0)
+    assert json.loads(ev2.to_json())["wall_ms"] == pytest.approx(250.0)
+
+
+def test_event_payload_may_carry_site_key():
+    # `site` is positional-only exactly so fault events can record the
+    # FAULT's site in their payload
+    bus = EventBus()
+    ev = bus.emit("fault.injected", site="serve.dispatch", tick=3)
+    assert ev.site == "fault.injected"
+    assert ev.payload["site"] == "serve.dispatch"
+
+
+def test_jsonl_sink_flush_is_atomic_and_complete(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    bus = EventBus(jsonl_path=path, flush_every=10**9)
+    for i in range(7):
+        bus.emit("s", i=i)
+    assert not os.path.exists(path)  # nothing published before flush
+    bus.flush()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 7
+    # every published line is complete, valid JSON (crash-safe contract)
+    assert [json.loads(ln)["payload"]["i"] for ln in lines] == list(range(7))
+    # no tmp litter
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+
+
+def test_pickled_bus_copy_never_writes_the_drivers_jsonl(tmp_path):
+    """Remote launchers ship the trainer (telemetry included) to worker
+    processes; the worker-side COPY must not clobber the driver-owned
+    jsonl segment — pickling strips the sink, keeps the ring."""
+    import pickle
+    path = str(tmp_path / "driver.jsonl")
+    tel = Telemetry(jsonl_path=path)
+    tel.event("driver.event")
+    tel.flush()
+    before = open(path, "rb").read()
+    copy = pickle.loads(pickle.dumps(tel))
+    copy.event("worker.event")
+    copy.flush()  # no-op on the file: the copy has no sink
+    assert open(path, "rb").read() == before
+    assert [e.site for e in copy.events()] == ["driver.event",
+                                               "worker.event"]
+
+
+def test_jsonl_sink_rotation(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    sink = JsonlSink(path, rotate_bytes=64)
+    for i in range(4):
+        sink.write(json.dumps({"i": i, "pad": "x" * 30}))
+        sink.flush()
+    assert os.path.exists(path + ".1")  # rotated generation
+    assert os.path.exists(path)         # fresh segment always published
+    # one generation kept: the rotated file holds the most recent full
+    # segment (older lines age out by design — memory/disk stay bounded)
+    kept = [json.loads(ln)["i"]
+            for ln in open(path + ".1").read().splitlines()]
+    cur = [json.loads(ln)["i"] for ln in open(path).read().splitlines()]
+    assert kept and kept + cur == list(range(4))[-len(kept) - len(cur):]
+
+
+# --------------------------------------------------------------------- #
+# metrics
+# --------------------------------------------------------------------- #
+def test_counter_and_gauge():
+    c = Counter("c")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    g = Gauge("g")
+    g.set(4)
+    g.dec()
+    assert g.value == 3.0
+
+
+def test_histogram_quantiles_match_numpy_exactly():
+    rng = np.random.default_rng(0)
+    xs = rng.lognormal(mean=2.0, sigma=1.0, size=500)
+    h = Histogram("lat")
+    for x in xs:
+        h.observe(x)
+    for q in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0):
+        assert h.quantile(q) == pytest.approx(
+            float(np.percentile(xs, 100 * q)), rel=1e-12)
+    assert h.count == 500
+    assert h.mean == pytest.approx(float(xs.mean()))
+
+
+def test_histogram_bucket_fallback_past_reservoir():
+    h = Histogram("lat", buckets=log_buckets(1.0, 1000.0, 10),
+                  max_samples=10)
+    xs = list(np.linspace(1.5, 900.0, 200))
+    for x in xs:
+        h.observe(x)
+    assert h.count == 200 and len(h._samples) == 10
+    # bucket interpolation: right bucket, bounded error
+    approx = h.quantile(0.5)
+    exact = float(np.percentile(xs, 50))
+    lo = max(b for b in h.buckets if b <= exact)
+    hi = min(b for b in h.buckets if b >= exact)
+    assert lo <= approx <= hi
+
+
+def test_histogram_counts_and_validation():
+    h = Histogram("h", buckets=[1, 10, 100])
+    for v in (0.5, 2, 3, 50, 200):
+        h.observe(v)
+    assert h.counts == [1, 2, 1, 1]  # last = +Inf overflow
+    with pytest.raises(ValueError, match="NaN"):
+        h.observe(float("nan"))
+    with pytest.raises(ValueError, match="empty"):
+        Histogram("e", buckets=[1]).quantile(0.5)
+
+
+def test_registry_get_or_create_and_type_conflict():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(TypeError, match="already registered"):
+        r.gauge("x")
+    snap_empty = MetricsRegistry().snapshot()
+    assert snap_empty == {}
+
+
+def test_registry_snapshot_and_prometheus_text():
+    r = MetricsRegistry()
+    r.counter("serve_requests_total", help="requests").inc(3)
+    r.gauge("serve_queue_depth").set(2)
+    h = r.histogram("serve_latency", buckets=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(5.0)
+    h.observe(50.0)
+    snap = r.snapshot()
+    assert snap["serve_requests_total"] == 3.0
+    assert snap["serve_latency"]["count"] == 3
+    assert snap["serve_latency"]["p50"] == pytest.approx(5.0)
+    text = r.prometheus_text()
+    assert "# TYPE serve_requests_total counter" in text
+    assert "# HELP serve_requests_total requests" in text
+    assert "# TYPE serve_latency histogram" in text
+    # cumulative buckets with the +Inf terminal
+    assert 'serve_latency_bucket{le="1"} 1' in text
+    assert 'serve_latency_bucket{le="10"} 2' in text
+    assert 'serve_latency_bucket{le="+Inf"} 3' in text
+    assert "serve_latency_count 3" in text
+
+
+def test_default_latency_buckets_are_log_spaced():
+    bs = DEFAULT_LATENCY_BUCKETS
+    ratios = [bs[i + 1] / bs[i] for i in range(len(bs) - 1)]
+    assert all(r == pytest.approx(ratios[0], rel=1e-9) for r in ratios)
+    assert bs[0] == pytest.approx(0.1) and bs[-1] == pytest.approx(60_000.0)
+
+
+# --------------------------------------------------------------------- #
+# spans / Chrome trace export (acceptance: valid JSON, correct nesting)
+# --------------------------------------------------------------------- #
+def test_spans_nest_and_chrome_trace_is_valid(tmp_path):
+    rec = SpanRecorder()  # tick mode: deterministic
+    with rec.span("fit", epochs=1):
+        with rec.span("epoch", epoch=0):
+            with rec.span("train_batch", idx=0):
+                pass
+            with rec.span("train_batch", idx=1):
+                pass
+        with rec.span("validation"):
+            pass
+    path = rec.export_chrome_trace(str(tmp_path / "host_trace.json"))
+    doc = json.loads(open(path).read())  # loads as valid JSON
+    evs = doc["traceEvents"]
+    assert all(e["ph"] == "X" for e in evs)
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+
+    def contains(outer, inner):
+        return (outer["ts"] <= inner["ts"] and
+                inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"])
+
+    fit, = by_name["fit"]
+    epoch, = by_name["epoch"]
+    val, = by_name["validation"]
+    assert contains(fit, epoch) and contains(fit, val)
+    for b in by_name["train_batch"]:
+        assert contains(epoch, b)
+    # siblings don't overlap
+    b0, b1 = sorted(by_name["train_batch"], key=lambda e: e["ts"])
+    assert b0["ts"] + b0["dur"] <= b1["ts"]
+    assert fit["args"] == {"epochs": 1}
+
+
+def test_span_begin_end_and_errors():
+    rec = SpanRecorder()
+    rec.begin("outer")
+    rec.begin("inner")
+    assert rec.open_depth == 2
+    rec.end()
+    rec.end()
+    assert rec.open_depth == 0
+    assert [s.name for s in rec.spans()] == ["inner", "outer"]
+    assert rec.spans("outer")[0].depth == 0
+    assert rec.spans("inner")[0].depth == 1
+    with pytest.raises(RuntimeError, match="no open span"):
+        rec.end()
+
+
+def test_span_capacity_drops_oldest():
+    rec = SpanRecorder(capacity=2)
+    for i in range(4):
+        with rec.span(f"s{i}"):
+            pass
+    assert [s.name for s in rec.spans()] == ["s2", "s3"]
+    assert rec.dropped == 2
+
+
+# --------------------------------------------------------------------- #
+# Telemetry handle + global activation
+# --------------------------------------------------------------------- #
+def test_telemetry_activation_is_scoped_and_nests():
+    assert get_global() is None
+    emit_global("x")  # no handle: a no-op, not an error
+    a, b = Telemetry(), Telemetry()
+    with a.activated():
+        emit_global("hit", n=1)
+        with b.activated():
+            emit_global("inner")
+        assert get_global() is a  # restored stack-wise
+        assert [e.site for e in a.events()] == ["hit"]
+        assert [e.site for e in b.events()] == ["inner"]
+    assert get_global() is None
+
+
+# --------------------------------------------------------------------- #
+# serve instrumentation
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+TRACE = [
+    (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+    (0, dict(prompt=[9, 2, 44], max_new_tokens=6)),
+    (3, dict(prompt=[42, 7], max_new_tokens=5)),
+    (5, dict(prompt=[1], max_new_tokens=6)),
+]
+
+
+def test_serve_request_lifecycle_events_and_metrics(nano):
+    """Every request leaves the full lifecycle trail — submit -> admit ->
+    first_token -> retire — in that order, and the vLLM-style metrics
+    (TTFT/latency/TPOT histograms, counters, occupancy gauges) add up."""
+    dec, params = nano
+    tel = Telemetry()
+    client = ServeClient(dec, params, num_slots=3, prefill_len=24,
+                         telemetry=tel)
+    out = client.serve_trace(TRACE)
+    assert len(out) == 4
+
+    for rid in range(4):
+        stages = [e.site for e in tel.events()
+                  if e.payload.get("id") == rid]
+        assert stages == ["serve.submit", "serve.admit",
+                          "serve.first_token", "serve.retire"], (rid,
+                                                                 stages)
+    m = tel.metrics
+    assert m.get("serve_requests_total").value == 4
+    assert m.get("serve_completions_total").value == 4
+    assert m.get("serve_finish_length_total").value == 4
+    assert m.get("serve_tokens_total").value == sum(
+        len(c.tokens) for c in out.values())
+    assert m.get("serve_latency").count == 4
+    assert m.get("serve_ttft").count == 4
+    # TPOT only for requests with >1 token (all of them here)
+    assert m.get("serve_tpot").count == 4
+    # drained: queue empty, no slot held
+    assert m.get("serve_queue_depth").value == 0
+    assert m.get("serve_slot_occupancy").value == 0
+    # tick-clock TTFT in the histogram matches the completion stamps
+    ttfts = sorted(c.time_to_first_token for c in out.values())
+    assert m.get("serve_ttft").quantile(0.5) == pytest.approx(
+        float(np.percentile(ttfts, 50)))
+
+
+def test_serve_rejections_and_timeouts_are_observable(nano):
+    dec, params = nano
+    from ray_lightning_tpu.serve import SchedulerConfig
+    tel = Telemetry()
+    client = ServeClient(dec, params, num_slots=1, prefill_len=4,
+                         scheduler_config=SchedulerConfig(
+                             max_queue_depth=1), telemetry=tel)
+    out = client.serve_trace([
+        (0, dict(prompt=[5, 17], max_new_tokens=3)),
+        (1, dict(prompt=[9], max_new_tokens=3, deadline=2.0)),  # expires
+        (1, dict(prompt=[42], max_new_tokens=3)),               # shed
+    ])
+    assert out[2].finish_reason == "rejected"
+    assert tel.metrics.get("serve_rejected_total").value == 1
+    assert [e.payload["id"] for e in tel.events("serve.reject")] == [2]
+    assert tel.metrics.get("serve_finish_timeout_total").value == 1
+    retires = {e.payload["id"]: e.payload["finish_reason"]
+               for e in tel.events("serve.retire")}
+    assert retires[1] == "timeout"
+
+
+def test_serve_disarmed_has_no_telemetry_attribute_cost(nano):
+    """telemetry=None is the default and the disarmed path must not
+    create a handle behind the user's back."""
+    dec, params = nano
+    client = ServeClient(dec, params, num_slots=1, prefill_len=4)
+    assert client._tel is None and client.engine._tel is None
+    client.submit([5], max_new_tokens=2)
+    client.run_until_idle()  # no AttributeError anywhere on the path
+
+
+# --------------------------------------------------------------------- #
+# determinism (ISSUE 4 satellite): byte-identical JSONL across runs
+# --------------------------------------------------------------------- #
+def _chaos_run(dec, params, jsonl_path):
+    """One tick-clock chaos serve: pinned FaultPlan + retry supervisor,
+    telemetry activated so the global channels land on the bus too."""
+    tel = Telemetry(jsonl_path=jsonl_path)
+    plan = FaultPlan.at("serve.dispatch", [0, 3])
+    with tel.activated():
+        client = ServeClient(
+            dec, params, num_slots=3, prefill_len=24, telemetry=tel,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay=0.0))
+        with plan.armed():
+            out = client.serve_trace(TRACE)
+    tel.flush()
+    return tel, out
+
+
+def test_chaos_event_log_is_byte_identical_across_runs(nano, tmp_path):
+    """PINNED: a tick-clock serve_trace under a pinned FaultPlan writes
+    the SAME BYTES to the JSONL log on two fresh runs — events must not
+    capture wall time when the tick clock is injected."""
+    dec, params = nano
+    p1, p2 = str(tmp_path / "run1.jsonl"), str(tmp_path / "run2.jsonl")
+    _, out1 = _chaos_run(dec, params, p1)
+    _, out2 = _chaos_run(dec, params, p2)
+    b1, b2 = open(p1, "rb").read(), open(p2, "rb").read()
+    assert b1 == b2 and len(b1) > 0
+    # and the runs really did the same work
+    assert {k: v.tokens for k, v in out1.items()} == \
+        {k: v.tokens for k, v in out2.items()}
+
+
+def test_chaos_event_log_order(nano, tmp_path):
+    """PINNED (acceptance): the chaos log contains the injected fault,
+    each retry attempt, the engine rebuild, and per-request replay
+    events, in order."""
+    dec, params = nano
+    tel, out = _chaos_run(dec, params, str(tmp_path / "chaos.jsonl"))
+    assert all(c.finish_reason == "length" for c in out.values())
+    sites = [e.site for e in tel.events()]
+
+    def idx_after(site, start):
+        for i in range(start, len(sites)):
+            if sites[i] == site:
+                return i
+        raise AssertionError(f"{site} not found after {start}: {sites}")
+
+    # two injected faults (ticks 0 and 3), each followed by suppression,
+    # a retry attempt, the rebuild, and the in-flight replays
+    pos = 0
+    for _round in range(2):
+        pos = idx_after("fault.injected", pos)
+        pos = idx_after("log.suppressed", pos)
+        pos = idx_after("retry.attempt", pos)
+        pos = idx_after("engine.rebuild", pos)
+        pos = idx_after("recovery.replay", pos)
+    # replay events name the in-flight requests (ids 0 and 1 both times)
+    replayed = [e.payload["id"] for e in tel.events("recovery.replay")]
+    assert sorted(set(replayed)) == [0, 1]
+    # second crash happens mid-decode: replays carry emitted tokens
+    assert any(e.payload["replayed_tokens"] > 0
+               for e in tel.events("recovery.replay"))
+    # the JSONL file holds the same ordered sites
+    lines = open(str(tmp_path / "chaos.jsonl")).read().splitlines()
+    assert [json.loads(ln)["site"] for ln in lines] == sites
+    # counters agree with the plan
+    assert tel.metrics.get("reliability_faults_total").value == 2
+    assert tel.metrics.get("reliability_rebuilds_total").value == 2
+
+
+def test_retry_exhaustion_events(nano):
+    dec, params = nano
+    tel = Telemetry()
+    plan = FaultPlan.at("serve.dispatch", range(64))
+    with tel.activated():
+        client = ServeClient(
+            dec, params, num_slots=2, prefill_len=8, telemetry=tel,
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.0))
+        with plan.armed():
+            out = client.serve_trace([(0, dict(prompt=[5],
+                                               max_new_tokens=3))])
+    assert out[0].finish_reason == "failed"
+    assert [e.payload["attempt"]
+            for e in tel.events("retry.attempt")] == [1, 2]
+    assert len(tel.events("retry.exhausted")) == 1
+    assert len(tel.events("recovery.exhausted")) == 1
+    assert tel.metrics.get("reliability_retries_total").value == 1
+
+
+# --------------------------------------------------------------------- #
+# step stats callback
+# --------------------------------------------------------------------- #
+def _fake_trainer():
+    return types.SimpleNamespace(callback_metrics={}, global_step=0,
+                                 block_until_ready=lambda: None)
+
+
+def _drive(cb, trainer, step_times, data_waits=None):
+    """Feed scripted (data_wait, step_time) pairs through the hook
+    sequence using the injected clock."""
+    t = [0.0]
+    cb._clock = lambda: t[0]
+    data_waits = data_waits or [0.0] * len(step_times)
+    cb.on_train_start(trainer, None)
+    cb.on_train_epoch_start(trainer, None)
+    for i, (wait, step) in enumerate(zip(data_waits, step_times)):
+        t[0] += wait
+        cb.on_train_batch_start(trainer, None, None, i)
+        t[0] += step
+        trainer.global_step = i + 1
+        cb.on_train_batch_end(trainer, None, {}, None, i)
+
+
+def test_stepstats_metrics_and_straggler_detection():
+    tel = Telemetry()
+    cb = StepStatsCallback(tel, warmup_steps=5, z_threshold=3.0)
+    trainer = _fake_trainer()
+    # 8 calm steps (~10ms, small jitter), then one 100ms straggler
+    times = [0.010, 0.011, 0.010, 0.009, 0.010, 0.011, 0.010, 0.010,
+             0.100]
+    _drive(cb, trainer, times, data_waits=[0.002] * len(times))
+    assert cb.anomalies == 1
+    assert trainer.callback_metrics["step_anomalies"] == 1.0
+    assert trainer.callback_metrics["step_time_ms"] == pytest.approx(100.0)
+    assert trainer.callback_metrics["step_time_z"] > 3.0
+    assert trainer.callback_metrics["data_wait_frac"] == pytest.approx(
+        0.002 / 0.102)
+    ev, = tel.events("train.straggler")
+    assert ev.payload["step"] == 9 and ev.payload["z"] > 3.0
+    assert tel.metrics.get("train_step_anomalies_total").value == 1
+    assert tel.metrics.get("train_step_ms").count == 9
+
+
+def test_stepstats_warmup_suppresses_anomalies():
+    cb = StepStatsCallback(warmup_steps=5)
+    trainer = _fake_trainer()
+    # the spike lands during warmup: no anomaly, and no telemetry needed
+    _drive(cb, trainer, [0.01, 0.01, 0.5, 0.01, 0.01])
+    assert cb.anomalies == 0
+    assert trainer.callback_metrics["step_anomalies"] == 0.0
+
+
+def test_stepstats_tokens_per_sec_inference():
+    cb = StepStatsCallback(warmup_steps=1)
+    trainer = _fake_trainer()
+    t = [0.0]
+    cb._clock = lambda: t[0]
+    cb.on_train_start(trainer, None)
+    batch = {"x": np.zeros((4, 16)), "y": np.zeros((4,))}
+    cb.on_train_batch_start(trainer, None, batch, 0)
+    t[0] += 0.5
+    cb.on_train_batch_end(trainer, None, {}, batch, 0)
+    # first 2-D leaf: 4 x 16 tokens over 0.5 s
+    assert trainer.callback_metrics["tokens_per_sec"] == pytest.approx(128.0)
+    # custom tokens_fn overrides inference
+    cb2 = StepStatsCallback(tokens_fn=lambda b: 1000)
+    cb2._clock = lambda: t[0]
+    cb2.on_train_start(trainer, None)
+    cb2.on_train_batch_start(trainer, None, batch, 0)
+    t[0] += 0.25
+    cb2.on_train_batch_end(trainer, None, {}, batch, 0)
+    assert trainer.callback_metrics["tokens_per_sec"] == pytest.approx(4000.0)
+
+
+def test_stepstats_validation():
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        StepStatsCallback(ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="z_threshold"):
+        StepStatsCallback(z_threshold=0)
+    with pytest.raises(ValueError, match="min_sigma_frac"):
+        StepStatsCallback(min_sigma_frac=-1)
+
+
+# --------------------------------------------------------------------- #
+# trainer integration
+# --------------------------------------------------------------------- #
+def test_trainer_emits_lifecycle_events(tmp_path):
+    from ray_lightning_tpu import RayStrategy, Trainer
+    from ray_lightning_tpu.models import BoringModel
+    tel = Telemetry()
+    cb = StepStatsCallback(tel, warmup_steps=2)
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=2,
+                      limit_train_batches=3, seed=0,
+                      default_root_dir=str(tmp_path), callbacks=[cb],
+                      telemetry=tel)
+    trainer.fit(BoringModel())
+    sites = [e.site for e in tel.events()]
+    for required in ("launch.start", "worker.start", "fit.start",
+                     "epoch.start", "epoch.end", "fit.end", "launch.done"):
+        assert required in sites, (required, sites)
+    assert sites.index("launch.start") < sites.index("worker.start") \
+        < sites.index("fit.start") < sites.index("epoch.start") \
+        < sites.index("epoch.end") < sites.index("fit.end") \
+        < sites.index("launch.done")
+    assert len([s for s in sites if s == "epoch.start"]) == 2
+    ep0 = next(e for e in tel.events("epoch.end"))
+    assert ep0.payload == {"epoch": 0, "global_step": 3}
+    # StepStats rode the existing rank-0 metric transport
+    assert "step_time_ms" in trainer.callback_metrics
+    assert "tokens_per_sec" in trainer.callback_metrics
+    assert tel.metrics.get("train_step_ms").count == 6
+
+
+def test_trainer_exports_profiler_sections_as_gauges(tmp_path):
+    from ray_lightning_tpu import RayStrategy, Trainer
+    from ray_lightning_tpu.models import BoringModel
+    tel = Telemetry()
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=2, seed=0,
+                      default_root_dir=str(tmp_path), profiler="simple",
+                      telemetry=tel)
+    trainer.fit(BoringModel())
+    snap = tel.metrics.snapshot()
+    assert snap["profile_train_step_s"] > 0
+    assert snap["profile_get_train_batch_s"] > 0
+
+
+def test_trainer_disarmed_by_default(tmp_path):
+    from ray_lightning_tpu import RayStrategy, Trainer
+    from ray_lightning_tpu.models import BoringModel
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=1,
+                      limit_train_batches=2, seed=0,
+                      default_root_dir=str(tmp_path))
+    assert trainer.telemetry is None
+    trainer.fit(BoringModel())  # no telemetry anywhere on the path
