@@ -1118,6 +1118,92 @@ def _bench_chaos(num_slots: int = 4, n_requests: int = 8,
     }
 
 
+def _bench_gang() -> dict:
+    """Gang kill-and-restart cost on the process backend.
+
+    One OS-process worker fits a BoringModel (3 epochs x 4 batches)
+    under :class:`GangSupervisor` twice: clean, then with a pinned
+    ``worker.exit`` fault hard-killing the worker at batch tick 9 of 12
+    — inside the final epoch (``os._exit``, the OOM/preemption death).
+    The supervisor detects the dead actor, tears the gang down,
+    re-launches on a fresh rendezvous, and resumes from the step-8
+    (end-of-epoch) checkpoint, re-running only the last epoch.
+    ``gang_recovery_ms`` is the extra wall the faulted run pays over the
+    clean one — detection + teardown + respawn (interpreter/jax cold
+    start dominates) + the ~1-epoch resume. Untracked (no regression
+    gate): spawn cost is environment noise; recorded for trend
+    visibility.
+    """
+    import shutil
+    import tempfile
+
+    from ray_lightning_tpu import (GangConfig, GangSupervisor,
+                                   ModelCheckpoint, RayStrategy,
+                                   RetryPolicy, Trainer)
+    from ray_lightning_tpu.launchers.process_backend import ProcessRay
+    from ray_lightning_tpu.launchers.ray_launcher import RayLauncher
+    from ray_lightning_tpu.models import BoringModel
+    from ray_lightning_tpu.reliability import FaultPlan
+
+    worker_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PALLAS_AXON_POOL_IPS": "",
+    }
+
+    def run(plan):
+        root = tempfile.mkdtemp(prefix="tl_bench_gang_")
+        ray_mod = ProcessRay(worker_env=dict(worker_env))
+        ray_mod.init()
+
+        def make_trainer():
+            strategy = RayStrategy(num_workers=1)
+            trainer = Trainer(
+                strategy=strategy, max_epochs=3, seed=0,
+                limit_train_batches=4, limit_val_batches=0,
+                callbacks=[ModelCheckpoint(
+                    dirpath=os.path.join(root, "ck"))],
+                default_root_dir=root)
+            trainer._launcher = RayLauncher(
+                strategy, ray_module=ray_mod,
+                gang=GangConfig(heartbeat_timeout=120.0))
+            return trainer
+
+        sup = GangSupervisor(make_trainer,
+                             RetryPolicy(max_attempts=3, base_delay=0.0),
+                             sleep=lambda s: None)
+        t0 = time.perf_counter()
+        try:
+            if plan is None:
+                sup.fit(BoringModel)
+            else:
+                with plan.armed():
+                    sup.fit(BoringModel)
+        finally:
+            ray_mod.shutdown()
+            shutil.rmtree(root, ignore_errors=True)
+        return time.perf_counter() - t0, sup
+
+    clean_s, _ = run(None)
+    fault_s, sup = run(FaultPlan.at("worker.exit", [9], mode="exit"))
+    if sup.restarts != 1 or not sup.failures:
+        raise MeasurementError(
+            f"gang scenario expected exactly 1 restart, saw "
+            f"{sup.restarts} (failures: {len(sup.failures)}) — the "
+            "pinned fault tick no longer lands past the last "
+            "epoch-boundary checkpoint")
+    return {
+        "backend": "process (1 OS-process worker, CPU)",
+        "fault": "worker.exit tick 9 of 12 (os._exit in the final epoch)",
+        "restarts": sup.restarts,
+        "attempts": sup.attempts,
+        "failure_reason": sup.failures[0].reason,
+        "faultfree_fit_s": round(clean_s, 2),
+        "faulted_fit_s": round(fault_s, 2),
+        "gang_recovery_ms": round(1e3 * max(0.0, fault_s - clean_s), 1),
+    }
+
+
 def _bench_obs(num_slots: int = 4, n_requests: int = 8,
                prompt: int = 32, new_tokens: int = 32,
                steps_per_dispatch: int = 4, repeats: int = 3) -> dict:
@@ -1627,6 +1713,12 @@ def main() -> None:
         extras["chaos"] = _bench_chaos()
     except Exception as exc:
         extras["chaos"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # gang kill-and-restart on the process backend, untracked
+        if isinstance(extras.get("chaos"), dict):
+            extras["chaos"]["gang"] = _bench_gang()
+    except Exception as exc:
+        extras["chaos"]["gang"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     try:
         # telemetry layer overhead, armed vs disarmed, untracked

@@ -42,7 +42,9 @@ from ray_lightning_tpu.core import (Trainer, TpuModule, TpuDataModule,
                                     EpochStatsCallback, seed_everything)
 from ray_lightning_tpu.launchers import RayLauncher, LocalLauncher
 from ray_lightning_tpu.reliability import (FaultPlan, FitSupervisor,
-                                           InjectedFault, NonFiniteError,
+                                           GangConfig, GangFailure,
+                                           GangSupervisor, InjectedFault,
+                                           NonFiniteError,
                                            RetriesExhausted, RetryPolicy,
                                            ServeSupervisor)
 from ray_lightning_tpu.obs import StepStatsCallback, Telemetry
@@ -57,7 +59,8 @@ __all__ = [
     "Callback", "EarlyStopping", "EMAWeightAveraging", "ModelCheckpoint",
     "EpochStatsCallback", "seed_everything",
     "RayLauncher", "LocalLauncher",
-    "FaultPlan", "FitSupervisor", "InjectedFault", "NonFiniteError",
+    "FaultPlan", "FitSupervisor", "GangConfig", "GangFailure",
+    "GangSupervisor", "InjectedFault", "NonFiniteError",
     "RetriesExhausted", "RetryPolicy", "ServeSupervisor",
     "StepStatsCallback", "Telemetry",
 ]

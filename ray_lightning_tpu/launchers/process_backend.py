@@ -36,6 +36,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 def _worker_main(conn, env: Dict[str, str]) -> None:
     """Actor process body: apply env BEFORE anything initializes a backend,
     then serve construct/call messages over the pipe until exit/EOF."""
+    # stamp the process as a disposable spawned worker: this is what
+    # authorizes the fault plan's hard-exit mode (faults.MODE_EXIT) to
+    # really os._exit here instead of degrading to a raise
+    os.environ.setdefault("TL_WORKER_PROCESS", "1")
     os.environ.update(env)
     actor = None
     while True:
@@ -145,6 +149,7 @@ class ProcessActorHandle:
         self._pending: List[ProcessFuture] = []
         self._pending_lock = threading.Lock()
         self._killed = False
+        self._dead = False  # latched by the reader on process death
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
         # construction is itself a pipelined call
@@ -159,6 +164,13 @@ class ProcessActorHandle:
         fut = ProcessFuture()
         with self._send_lock:
             with self._pending_lock:
+                if self._dead:
+                    # the reader already drained the pipe and exited: a
+                    # send could still "succeed" into the broken pipe's
+                    # buffer and this future would never resolve — fail
+                    # it now instead of blocking a caller forever
+                    fut._resolve(error=self._death_error())
+                    return fut
                 self._pending.append(fut)
             try:
                 self._conn.send(message)
@@ -185,10 +197,13 @@ class ProcessActorHandle:
             try:
                 status, payload = self._conn.recv()
             except (EOFError, OSError):
-                # process died: fail everything still in flight
-                err = self._death_error()
+                # process died: latch death FIRST (under the lock, so a
+                # racing _enqueue either lands in `pending` here or sees
+                # the latch), then fail everything still in flight
                 with self._pending_lock:
+                    self._dead = True
                     pending, self._pending = self._pending, []
+                err = self._death_error()
                 for fut in pending:
                     fut._resolve(error=err)
                 return
@@ -214,14 +229,25 @@ class ProcessActorHandle:
 
     def _kill(self) -> None:
         self._killed = True
-        try:
-            with self._send_lock:
-                self._conn.send(("exit",))
-        except (BrokenPipeError, OSError):
-            pass
-        self._proc.join(timeout=5)
+        with self._pending_lock:
+            busy = bool(self._pending) or self._dead
+        if not busy:
+            # idle actor: ask it to exit cleanly and give it a moment
+            try:
+                with self._send_lock:
+                    self._conn.send(("exit",))
+            except (BrokenPipeError, OSError):
+                pass
+            self._proc.join(timeout=5)
+        # busy (or unresponsive) actor: the worker serves messages FIFO,
+        # so an "exit" would queue behind the in-flight call — which may
+        # be stalled/wedged (exactly why a gang teardown is killing it).
+        # Terminate immediately instead of waiting out the grace join.
         if self._proc.is_alive():
             self._proc.terminate()
+            self._proc.join(timeout=5)
+        if self._proc.is_alive():  # SIGTERM ignored/blocked: escalate
+            self._proc.kill()
             self._proc.join(timeout=5)
         try:
             self._conn.close()
@@ -249,11 +275,21 @@ class ProcessRemoteClass:
 
 class _ManagerQueue:
     """Cross-process queue with the ray.util.queue.Queue surface the
-    launcher/session need (put/get/empty/shutdown)."""
+    launcher/session need (put/get/empty/shutdown).
 
-    def __init__(self, manager):
+    Pickles *by reference*, like a Ray queue's actor handle: only the
+    manager proxy crosses the boundary (the SyncManager itself is
+    unpicklable — it owns an AuthenticationString), and every unpickled
+    copy funnels to the same manager-hosted queue. This is what lets
+    worker processes push heartbeats/reports into a driver-owned queue
+    that was shipped to them as a task argument."""
+
+    def __init__(self, manager=None, proxy: Any = None):
         self._manager = manager
-        self._q = manager.Queue()
+        self._q = proxy if proxy is not None else manager.Queue()
+
+    def __reduce__(self):
+        return (_rebuild_manager_queue, (self._q,))
 
     def put(self, item: Any) -> None:
         self._q.put(item)
@@ -266,6 +302,10 @@ class _ManagerQueue:
 
     def shutdown(self) -> None:  # queue dies with the backend's manager
         pass
+
+
+def _rebuild_manager_queue(proxy: Any) -> "_ManagerQueue":
+    return _ManagerQueue(proxy=proxy)
 
 
 class ProcessRay:
@@ -334,7 +374,7 @@ class ProcessRay:
                     and time.monotonic() >= deadline):
                 not_ready = [r for r in refs if r not in ready]
                 return ready, not_ready
-            time.sleep(0.005)
+            time.sleep(0.005)  # tl-lint: allow-sleep — ray.wait poll quantum (wall-clock by contract)
 
     # -- actors -------------------------------------------------------- #
     def remote(self, cls: type) -> ProcessRemoteClass:

@@ -137,7 +137,8 @@ class RayLauncher:
     """
 
     def __init__(self, strategy, ray_module: Any = None,
-                 workers: Optional[List[Any]] = None):
+                 workers: Optional[List[Any]] = None,
+                 gang: Optional[Any] = None):
         """``workers``: externally-owned executor actors to reuse instead
         of creating (and killing) a fresh set per ``launch()``. The
         caller owns their lifetime. Consecutive fits skip actor spawn +
@@ -147,6 +148,16 @@ class RayLauncher:
         process count and rank order. The reference's analog is Tune's
         ``reuse_actors``; here it is a launcher-level seam (also what
         keeps the multiproc test tier affordable).
+
+        ``gang``: a :class:`~ray_lightning_tpu.reliability.gang.GangConfig`
+        arms gang supervision — per-rank worker heartbeats over a side
+        channel, a driver-side watchdog in the result poll (a rank silent
+        past ``heartbeat_timeout`` or a dead actor escalates to
+        :class:`~ray_lightning_tpu.reliability.gang.GangFailure` with a
+        per-rank postmortem), and full-gang teardown on failure (peers
+        wedged in a collective never exit on their own). ``None`` (the
+        default) keeps the fail-fast-only fault model with zero added
+        cost.
         """
         self._strategy = strategy
         self._ray = ray_module if ray_module is not None else _import_ray()
@@ -172,6 +183,12 @@ class RayLauncher:
         self.queue: Any = None
         self._master_addr: Optional[str] = None
         self._master_port: Optional[int] = None
+        # gang supervision state (all None/False when disarmed)
+        self._gang = gang
+        self._gang_channel: Any = None
+        self._gang_monitor: Any = None
+        self._gang_failed = False
+        self._tel: Any = None  # driver-side telemetry, captured per launch
 
     @property
     def is_interactive_compatible(self) -> bool:
@@ -188,12 +205,21 @@ class RayLauncher:
         # and sink live in this process, worker-side events come back as
         # callback_metrics (the existing rank-0 transport)
         tel = getattr(trainer, "telemetry", None)
+        self._tel = tel  # detection/teardown events ride the same handle
+        # reset here, not only in setup_workers: a setup that fails BEFORE
+        # reaching the reset (actor creation, init_hook, rendezvous fire)
+        # must not inherit a stale verdict from the previous launch
+        self._gang_failed = False
         if tel is not None:
             tel.event("launch.start", launcher="ray",
                       num_workers=getattr(self._strategy, "num_workers",
                                           1))
-        self.setup_workers()
         try:
+            # setup inside the guarded region: a rendezvous/scheduling
+            # failure (e.g. an injected rendezvous.init fault) must still
+            # release any actors already created — a supervising retry
+            # re-runs setup_workers on a clean slate, fresh port included
+            self.setup_workers()
             output = self.run_function_on_workers(
                 function, *args, trainer=trainer, **kwargs)
         finally:
@@ -225,8 +251,14 @@ class RayLauncher:
             ])
 
         # Coordinator (rendezvous) on worker 0's node — probed remotely so a
-        # driver off the cluster network (client mode) still works.
+        # driver off the cluster network (client mode) still works. Each
+        # setup probes a FRESH port: after a gang failure the old
+        # coordinator may be half-dead but still bound, and a restarted
+        # world must never rendezvous with it (the fault seat here lets
+        # chaos tests fail/stall exactly this brokering step).
         # Parity: ``ray_launcher.py:85-87``.
+        from ray_lightning_tpu.reliability import faults as _faults
+        _faults.fire("rendezvous.init")
         self._master_addr = self._ray.get(self._workers[0].get_node_ip.remote())
         self._master_port = self._ray.get(
             self._workers[0].execute.remote(find_free_port))
@@ -244,23 +276,32 @@ class RayLauncher:
                 self._set_own_chip_visibility()
         strategy.set_global_to_local(self.get_local_ranks(node_ips))
 
+        self._gang_failed = False
+        if self._gang is not None:
+            from ray_lightning_tpu.reliability.gang import GangMonitor
+            self._gang_channel = self._make_queue_channel()
+            self._gang_monitor = GangMonitor(
+                strategy.num_workers, self._gang, node_ips=node_ips,
+                telemetry=self._tel)
+
         self.queue = None
         if tune_enabled and self._in_tune_session():
-            # Gate on the *injected* module: a fake-ray launcher must never
-            # spin up a real Ray queue actor even if ray is importable.
-            make_queue = getattr(self._ray, "make_queue", None)
-            if make_queue is not None:
-                # backend provides its own cross-boundary queue (e.g. the
-                # subprocess backend's manager queue)
-                self.queue = make_queue()
-            elif getattr(self._ray, "__name__", "") == "ray":
-                from ray.util.queue import Queue
-                self.queue = Queue(actor_options={"num_cpus": 0})
-            else:
-                # In-process fake: a thread queue gives the same
-                # put/get/empty surface the session requires.
-                import queue as _queue
-                self.queue = _queue.Queue()
+            self.queue = self._make_queue_channel()
+
+    def _make_queue_channel(self):
+        """One driver-owned cross-boundary queue, per backend flavor:
+        the backend's own (e.g. the subprocess manager queue), a real Ray
+        queue actor, or — for in-process fakes — a plain thread queue.
+        Gated on the *injected* module: a fake-ray launcher must never
+        spin up a real Ray queue actor even if ray is importable."""
+        make_queue = getattr(self._ray, "make_queue", None)
+        if make_queue is not None:
+            return make_queue()
+        if getattr(self._ray, "__name__", "") == "ray":
+            from ray.util.queue import Queue
+            return Queue(actor_options={"num_cpus": 0})
+        import queue as _queue
+        return _queue.Queue()
 
     def _create_worker(self, rank: int):
         """One actor per TPU host. Parity: ``_create_worker``
@@ -467,11 +508,26 @@ class RayLauncher:
         num_workers = self._strategy.num_workers
         global_to_local = self._strategy.global_to_local
         queue = self.queue
+        # ship the armed fault plan to workers: chaos schedules written on
+        # the driver inject in remote processes too (each worker arms its
+        # own copy — worker-site ticks count per process, per attempt)
+        from ray_lightning_tpu.reliability import faults as _faults
+        fault_plan = _faults.get_armed()
+
+        def _heartbeat_for(rank: int):
+            if self._gang_channel is None:
+                return None
+            # built driver-side so GangConfig's throttle applies; the
+            # channel inside pickles by reference into the worker
+            from ray_lightning_tpu.reliability.gang import HeartbeatEmitter
+            return HeartbeatEmitter(self._gang_channel, rank,
+                                    interval=self._gang.heartbeat_interval)
 
         futures = [
             w.execute.remote(self._wrapping_function, rank, global_to_local,
                              trainer_ref, fn_name, args, kwargs, coordinator,
-                             num_workers, queue)
+                             num_workers, queue, _heartbeat_for(rank),
+                             fault_plan)
             for rank, w in enumerate(self._workers)
         ]
         results = self._process_results(futures, queue)
@@ -480,10 +536,17 @@ class RayLauncher:
     @staticmethod
     def _wrapping_function(global_rank: int, global_to_local, trainer_ref,
                            fn_name: str, args, kwargs, coordinator: str,
-                           num_processes: int, queue) -> Optional[Any]:
+                           num_processes: int, queue, heartbeat=None,
+                           fault_plan=None) -> Optional[Any]:
         """Worker-side entry (parity: ``ray_launcher.py:253-311``):
         deserialize trainer, wire ranks/session, initialize the distributed
-        runtime, run the real work, return rank-0's output only."""
+        runtime, run the real work, return rank-0's output only.
+
+        ``heartbeat`` (when gang supervision is armed) is this rank's
+        :class:`~ray_lightning_tpu.reliability.gang.HeartbeatEmitter`
+        back to the driver's watchdog; ``fault_plan`` is the driver's
+        armed chaos schedule, armed here too so remote workers inject
+        the same failures an in-process fit would."""
         trainer = trainer_ref
         if hasattr(trainer_ref, "_is_fake_object_ref"):
             trainer = trainer_ref.value  # in-process fake store (tests)
@@ -491,6 +554,12 @@ class RayLauncher:
             ray = _import_ray()
             if ray is not None and isinstance(trainer_ref, ray.ObjectRef):
                 trainer = ray.get(trainer_ref)
+
+        from ray_lightning_tpu.reliability import faults as _faults
+        armed_here = (fault_plan is not None
+                      and _faults.ensure_armed(fault_plan))
+        if heartbeat is not None:
+            heartbeat.beat(-1)  # alive: worker entered, before any setup
 
         reset_seed()
         strategy = trainer.strategy
@@ -502,11 +571,16 @@ class RayLauncher:
             strategy.worker_setup(process_idx=global_rank,
                                   num_processes=num_processes,
                                   coordinator_address=coordinator)
-            trainer._launcher = _WorkerSideQueueShim(queue, global_rank)
+            if heartbeat is not None:
+                heartbeat.beat(-1)  # alive: rendezvous done
+            trainer._launcher = _WorkerSideQueueShim(queue, global_rank,
+                                                     heartbeat=heartbeat)
             function = getattr(trainer, fn_name)
             results = function(*args, **kwargs)
         finally:
             _session.shutdown_session()
+            if armed_here:
+                _faults.disarm()
 
         if strategy.global_rank == 0:
             return results
@@ -517,16 +591,53 @@ class RayLauncher:
 
         Parity: ``process_results`` (``util.py:57-70``) — queued thunks
         (Tune reports) must execute in *this* (driver/trial) process.
+
+        With gang supervision armed the same poll is the watchdog: each
+        pass drains the heartbeat channel into the :class:`GangMonitor`,
+        and a rank silent past its timeout — or a failed worker future —
+        escalates to a :class:`GangFailure` carrying the per-rank
+        postmortem. The unwind through ``launch()`` then tears the FULL
+        gang down: peers wedged in a collective with the lost rank will
+        never finish, so killing them is the only way the driver (and a
+        supervising retry) ever moves again.
         """
         unfinished = list(futures)
+        monitor = self._gang_monitor
+        if monitor is not None:
+            monitor.start()
         while unfinished:
             if queue is not None:
                 self._drain_queue(queue)
+            if monitor is not None:
+                monitor.drain(self._gang_channel)
+                silent = monitor.silent_ranks()
+                if silent:
+                    self._gang_failed = True
+                    raise monitor.heartbeat_failure(silent)
             ready, unfinished = self._ray.wait(unfinished, timeout=0.05)
             # Raise a failed worker's error NOW (reference util.py:62-63):
             # peers blocked in a collective with the dead rank will never
             # finish, so waiting for all futures first would hang forever.
-            self._ray.get(ready)
+            for ref in ready:
+                try:
+                    self._ray.get(ref)
+                    if monitor is not None:
+                        # this rank is DONE: it stops beating by design,
+                        # and completion skew vs slower peers must not
+                        # read as a hang
+                        monitor.mark_done(futures.index(ref))
+                except Exception as exc:
+                    if monitor is None:
+                        raise  # fail-fast fault model (gang disarmed)
+                    self._gang_failed = True
+                    monitor.drain(self._gang_channel)
+                    rank = futures.index(ref)
+                    from ray_lightning_tpu.reliability.gang import \
+                        actor_alive
+                    dead = (rank < len(self._workers)
+                            and not actor_alive(self._workers[rank]))
+                    raise monitor.worker_failure(rank, exc,
+                                                 dead=dead) from exc
         if queue is not None:
             self._drain_queue(queue)
         return self._ray.get(futures)
@@ -546,11 +657,30 @@ class RayLauncher:
         """Kill actors without restart (parity: ``ray_launcher.py:117-129``)
         — fail-fast is the reference's fault model (SURVEY.md §5): worker
         death surfaces as a raised ``ray.get``, recovery belongs to Tune.
-        Externally-owned workers are released, not killed — their
-        lifetime belongs to the caller."""
+        Externally-owned workers are released, not killed — their lifetime
+        belongs to the caller — EXCEPT after a gang failure: a gang that
+        lost a rank is wedged (survivors sit in collectives that will
+        never complete), so reuse is impossible and the whole gang dies
+        regardless of ownership."""
+        if self._gang_failed and self._tel is not None:
+            from ray_lightning_tpu.reliability.gang import \
+                EVENT_GANG_TEARDOWN
+            self._tel.event(EVENT_GANG_TEARDOWN,
+                            num_workers=len(self._workers))
         if self._external_workers is None:
             for worker in self._workers:
                 self._ray.kill(worker, no_restart=True)
+        elif self._gang_failed:
+            from ray_lightning_tpu.reliability import logger as _rlogger
+            _rlogger.warning(
+                "gang failure with externally-owned workers: killing all "
+                "%d (a wedged gang cannot be reused); the next launch on "
+                "this launcher will create fresh actors", len(self._workers))
+            for worker in self._workers:
+                self._ray.kill(worker, no_restart=True)
+            # drop the dead handles: a later setup_workers must respawn,
+            # not silently adopt killed actors from the reuse seam
+            self._external_workers = None
         self._workers = []
         if self.queue is not None:
             try:
@@ -558,6 +688,13 @@ class RayLauncher:
             except AttributeError:
                 pass
             self.queue = None
+        if self._gang_channel is not None:
+            try:
+                self._gang_channel.shutdown()
+            except AttributeError:
+                pass  # plain thread queues have no shutdown
+            self._gang_channel = None
+        self._gang_monitor = None
 
 
 class _WorkerSideQueueShim:
@@ -565,11 +702,21 @@ class _WorkerSideQueueShim:
     ``launcher.drain_queue()`` between batches; on a remote worker the queue
     belongs to the driver, so rank != 0 (and the driver's poll loop) own
     draining — this shim makes the call a no-op instead of an AttributeError.
-    """
 
-    def __init__(self, queue, rank: int):
+    It is also the trainer's heartbeat seat: with gang supervision armed
+    the fit loop's per-batch ``launcher.heartbeat(step)`` forwards to the
+    rank's :class:`~ray_lightning_tpu.reliability.gang.HeartbeatEmitter`
+    (a no-op otherwise — launchers without the attribute are skipped by
+    the trainer's ``getattr`` guard)."""
+
+    def __init__(self, queue, rank: int, heartbeat=None):
         self.queue = queue
         self.rank = rank
+        self._heartbeat = heartbeat
 
     def drain_queue(self) -> None:
         return None
+
+    def heartbeat(self, step: int) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.beat(step)

@@ -22,6 +22,12 @@ production stack needs *between* "error raised" and "request failed":
   ``ckpt_path="auto"`` under the policy).
 - :mod:`~ray_lightning_tpu.reliability.guard` — the trainer's
   non-finite loss/gradient guard helpers.
+- :mod:`~ray_lightning_tpu.reliability.gang` — gang supervision for
+  *distributed* fits: per-rank worker heartbeats, driver-side hang/death
+  detection with per-rank postmortems (:class:`GangMonitor` /
+  :class:`GangFailure`), and :class:`GangSupervisor`, which restarts the
+  full gang on a fresh rendezvous and resumes from the newest committed
+  checkpoint.
 
 See ``docs/reliability.md`` for the full semantics (fault sites, retry
 contract, the replay-exactness argument, and ``resume="auto"``).
@@ -57,19 +63,28 @@ def log_suppressed(site: str, exc: BaseException, detail: str = "") -> None:
 
 
 from ray_lightning_tpu.reliability.faults import (  # noqa: E402
-    FaultPlan, FaultSpec, InjectedFault, MODE_NAN, MODE_RAISE, MODE_STALL,
-    SITE_CKPT_SAVE, SITE_LOADER_NEXT, SITE_SERVE_DISPATCH, SITE_TRAIN_STEP,
-    arm, disarm, fire)
+    FaultPlan, FaultSpec, InjectedFault, MODE_EXIT, MODE_NAN, MODE_RAISE,
+    MODE_STALL, SITE_CKPT_SAVE, SITE_LOADER_NEXT, SITE_RENDEZVOUS_INIT,
+    SITE_SERVE_DISPATCH, SITE_TRAIN_STEP, SITE_WORKER_EXIT,
+    SITE_WORKER_STALL, arm, disarm, ensure_armed, fire, get_armed)
 from ray_lightning_tpu.reliability.guard import NonFiniteError  # noqa: E402
 from ray_lightning_tpu.reliability.retry import (  # noqa: E402
     RetriesExhausted, RetryPolicy, call_with_retry)
 from ray_lightning_tpu.reliability.supervisor import (  # noqa: E402
     FitSupervisor, ServeSupervisor)
+from ray_lightning_tpu.reliability.gang import (  # noqa: E402
+    GangConfig, GangFailure, GangMonitor, GangSupervisor, HeartbeatEmitter,
+    RankPostmortem)
 
 __all__ = [
-    "FaultPlan", "FaultSpec", "InjectedFault", "MODE_NAN", "MODE_RAISE",
-    "MODE_STALL", "SITE_CKPT_SAVE", "SITE_LOADER_NEXT",
-    "SITE_SERVE_DISPATCH", "SITE_TRAIN_STEP", "arm", "disarm", "fire",
+    "FaultPlan", "FaultSpec", "InjectedFault", "MODE_EXIT", "MODE_NAN",
+    "MODE_RAISE", "MODE_STALL", "SITE_CKPT_SAVE", "SITE_LOADER_NEXT",
+    "SITE_RENDEZVOUS_INIT", "SITE_SERVE_DISPATCH", "SITE_TRAIN_STEP",
+    "SITE_WORKER_EXIT", "SITE_WORKER_STALL", "arm", "disarm",
+    "ensure_armed", "fire", "get_armed",
     "NonFiniteError", "RetriesExhausted", "RetryPolicy", "call_with_retry",
-    "FitSupervisor", "ServeSupervisor", "logger", "log_suppressed",
+    "FitSupervisor", "ServeSupervisor",
+    "GangConfig", "GangFailure", "GangMonitor", "GangSupervisor",
+    "HeartbeatEmitter", "RankPostmortem",
+    "logger", "log_suppressed",
 ]

@@ -18,7 +18,23 @@ Sites are woven into the hot paths as a single ``fire(site)`` call:
 ``ckpt.save``         inside checkpoint writers, *before the commit
                       point* (a ``raise`` here = killed mid-save)
 ``loader.next``       per batch fetched by the trainer's prefetcher
+``worker.exit``       per trainer batch, worker-side — ``mode="exit"``
+                      hard-kills the worker process (``os._exit``), the
+                      no-exception death of an OOM-kill/preemption
+``worker.stall``      per trainer batch, worker-side — ``mode="stall"``
+                      wedges the training loop (heartbeats stop, the
+                      gang watchdog's hang verdict)
+``rendezvous.init``   driver-side, at the top of the launcher's
+                      rendezvous brokering in ``setup_workers``
 ====================  ====================================================
+
+The worker sites additionally carry the firing worker's **rank**
+(``fire(site, rank=...)``); a :class:`FaultSpec` with ``rank`` set only
+matches that rank, ``rank=None`` matches any. Remote launchers ship the
+armed plan to each worker process, which arms its own copy — worker-site
+tick counters therefore restart per launch attempt, while driver-side
+sites (``rendezvous.init``) keep counting across restarts (see
+``docs/reliability.md#gang-supervision``).
 
 When no plan is armed (the default), ``fire`` is one global read and a
 ``None`` check — the injection machinery costs nothing in production.
@@ -27,14 +43,19 @@ Modes: ``raise`` throws :class:`InjectedFault` (a crash), ``nan``
 returns a verdict the call site uses to NaN-poison its payload (only
 meaningful where there is a float payload: ``train.step`` /
 ``loader.next``), ``stall`` sleeps ``stall_s`` inside ``fire`` (a slow
-dependency, exercising deadlines/backoff).
+dependency, exercising deadlines/backoff), ``exit`` hard-exits the
+process — but only when it really is a spawned worker process (the
+subprocess backend stamps ``TL_WORKER_PROCESS``); in-process backends
+degrade it to ``raise`` so a fake-ray test can never kill the test
+runner.
 """
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ray_lightning_tpu.reliability import logger
 
@@ -42,17 +63,28 @@ SITE_SERVE_DISPATCH = "serve.dispatch"
 SITE_TRAIN_STEP = "train.step"
 SITE_CKPT_SAVE = "ckpt.save"
 SITE_LOADER_NEXT = "loader.next"
+SITE_WORKER_EXIT = "worker.exit"
+SITE_WORKER_STALL = "worker.stall"
+SITE_RENDEZVOUS_INIT = "rendezvous.init"
 
 MODE_RAISE = "raise"
 MODE_NAN = "nan"
 MODE_STALL = "stall"
+MODE_EXIT = "exit"
 
-# which modes make sense where: nan needs a float payload to poison
+#: set (to "1") in spawned worker processes; gates the hard-exit mode
+WORKER_PROCESS_ENV = "TL_WORKER_PROCESS"
+
+# which modes make sense where: nan needs a float payload to poison,
+# exit needs a disposable process to kill
 SITES: Dict[str, Tuple[str, ...]] = {
     SITE_SERVE_DISPATCH: (MODE_RAISE, MODE_STALL),
     SITE_TRAIN_STEP: (MODE_RAISE, MODE_NAN, MODE_STALL),
     SITE_CKPT_SAVE: (MODE_RAISE, MODE_STALL),
     SITE_LOADER_NEXT: (MODE_RAISE, MODE_NAN, MODE_STALL),
+    SITE_WORKER_EXIT: (MODE_EXIT, MODE_RAISE),
+    SITE_WORKER_STALL: (MODE_STALL, MODE_RAISE),
+    SITE_RENDEZVOUS_INIT: (MODE_RAISE, MODE_STALL),
 }
 
 
@@ -67,11 +99,16 @@ class InjectedFault(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """One scheduled failure: ``site`` fires its ``at``-th time → ``mode``."""
+    """One scheduled failure: ``site`` fires its ``at``-th time → ``mode``.
+
+    ``rank`` (optional) restricts the spec to one worker rank at sites
+    whose ``fire`` passes a rank (the ``worker.*`` sites); ``None``
+    matches any rank."""
     site: str
     at: int
     mode: str = MODE_RAISE
     stall_s: float = 0.01
+    rank: Optional[int] = None
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -86,6 +123,8 @@ class FaultSpec:
             raise ValueError(f"at must be >= 0, got {self.at}")
         if self.stall_s < 0:
             raise ValueError(f"stall_s must be >= 0, got {self.stall_s}")
+        if self.rank is not None and self.rank < 0:
+            raise ValueError(f"rank must be >= 0 or None, got {self.rank}")
 
 
 class FaultPlan:
@@ -106,14 +145,18 @@ class FaultPlan:
     retry".
     """
 
-    def __init__(self, specs: Iterable[FaultSpec] = ()):
+    def __init__(self, specs: Iterable[FaultSpec] = (),
+                 sleep: Callable[[float], None] = time.sleep):
         self.specs: List[FaultSpec] = list(specs)
-        self._by_key: Dict[Tuple[str, int], FaultSpec] = {}
+        self._sleep = sleep  # injectable: stall tests stay wall-clock-free
+        self._by_key: Dict[Tuple[str, int, Optional[int]], FaultSpec] = {}
         for spec in self.specs:
-            key = (spec.site, spec.at)
+            key = (spec.site, spec.at, spec.rank)
             if key in self._by_key:
                 raise ValueError(
-                    f"duplicate fault at {spec.site!r} tick {spec.at}")
+                    f"duplicate fault at {spec.site!r} tick {spec.at}"
+                    + (f" rank {spec.rank}" if spec.rank is not None
+                       else ""))
             self._by_key[key] = spec
         self._counts: Dict[str, int] = {site: 0 for site in SITES}
         self.fired = 0
@@ -121,9 +164,12 @@ class FaultPlan:
     # ------------------------------------------------------ constructors
     @classmethod
     def at(cls, site: str, ticks: Iterable[int],
-           mode: str = MODE_RAISE, stall_s: float = 0.01) -> "FaultPlan":
+           mode: str = MODE_RAISE, stall_s: float = 0.01,
+           rank: Optional[int] = None,
+           sleep: Callable[[float], None] = time.sleep) -> "FaultPlan":
         """Schedule ``mode`` at ``site`` for every tick in ``ticks``."""
-        return cls(FaultSpec(site, int(t), mode, stall_s) for t in ticks)
+        return cls((FaultSpec(site, int(t), mode, stall_s, rank)
+                    for t in ticks), sleep=sleep)
 
     @classmethod
     def random(cls, seed: int, n_faults: int,
@@ -170,20 +216,27 @@ class FaultPlan:
         self._counts = {site: 0 for site in SITES}
         self.fired = 0
 
-    def fire(self, site: str) -> Optional[str]:
+    def fire(self, site: str, rank: Optional[int] = None) -> Optional[str]:
         """Advance ``site``'s tick; inject if a spec is scheduled there.
 
+        ``rank`` is the firing worker's rank at the ``worker.*`` sites
+        (rank-addressed specs match it; rank-less specs match anyone).
         Returns ``None`` (no fault), ``MODE_NAN`` (caller poisons its
         payload) or ``MODE_STALL`` (the sleep already happened); raises
-        :class:`InjectedFault` for ``MODE_RAISE``.
+        :class:`InjectedFault` for ``MODE_RAISE``; ``MODE_EXIT`` hard-
+        exits a spawned worker process (``os._exit(17)``) and degrades
+        to a raise everywhere else.
         """
         tick = self._counts[site]
         self._counts[site] = tick + 1
-        spec = self._by_key.get((site, tick))
+        spec = self._by_key.get((site, tick, rank))
+        if spec is None and rank is not None:
+            spec = self._by_key.get((site, tick, None))
         if spec is None:
             return None
         self.fired += 1
-        logger.warning("injecting %s at %s tick %d", spec.mode, site, tick)
+        logger.warning("injecting %s at %s tick %d (rank %s)", spec.mode,
+                       site, tick, "any" if rank is None else rank)
         # chaos is observable, not just survivable: injections land on
         # the activated telemetry's event bus (no-op without one)
         from ray_lightning_tpu import obs
@@ -196,8 +249,17 @@ class FaultPlan:
                 help="faults injected by the armed FaultPlan").inc()
         if spec.mode == MODE_RAISE:
             raise InjectedFault(site, tick)
+        if spec.mode == MODE_EXIT:
+            if os.environ.get(WORKER_PROCESS_ENV):
+                # the no-exception death (OOM-killer, preemption): no
+                # unwind, no teardown, the pipe just goes quiet
+                os._exit(17)
+            logger.warning(
+                "worker.exit fired outside a spawned worker process; "
+                "degrading to raise so in-process backends survive")
+            raise InjectedFault(site, tick)
         if spec.mode == MODE_STALL:
-            time.sleep(spec.stall_s)
+            self._sleep(spec.stall_s)
         return spec.mode
 
     # ------------------------------------------------------------ arming
@@ -238,9 +300,31 @@ def disarm() -> None:
         _ACTIVE = None
 
 
-def fire(site: str) -> Optional[str]:
+def get_armed() -> Optional[FaultPlan]:
+    """The currently armed plan (None when disarmed). Remote launchers
+    use this to ship the active plan into worker processes."""
+    return _ACTIVE
+
+
+def ensure_armed(plan: FaultPlan) -> bool:
+    """Arm ``plan`` iff nothing is armed yet; returns whether this call
+    armed it (and therefore owns the matching ``disarm()``).
+
+    The worker-side seat of plan shipping: a spawned worker process arms
+    the shipped copy; an in-process fake "worker" sees the driver's plan
+    already armed and leaves it alone (one tick ledger per process).
+    """
+    global _ACTIVE
+    with _LOCK:
+        if _ACTIVE is None:
+            _ACTIVE = plan
+            return True
+        return False
+
+
+def fire(site: str, rank: Optional[int] = None) -> Optional[str]:
     """Hot-path hook: no-op (one global read) unless a plan is armed."""
     plan = _ACTIVE
     if plan is None:
         return None
-    return plan.fire(site)
+    return plan.fire(site, rank)

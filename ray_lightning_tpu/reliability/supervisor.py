@@ -200,9 +200,14 @@ class FitSupervisor:
 
     ``make_trainer`` builds a *fresh* trainer per attempt (never reuse a
     crashed one — its device state may be poisoned); ``module`` may be an
-    instance or a zero-arg factory. Every attempt fits with
-    ``ckpt_path="auto"``, so attempt N+1 resumes from the newest valid
-    checkpoint attempt N managed to commit. Raises
+    instance or a zero-arg factory. The same poisoning argument applies
+    to the module itself: a crashed attempt may leave mutated state
+    behind, so each attempt fits a **deep copy** of the caller's
+    instance (the original is never attached or mutated). A module that
+    cannot be deep-copied is reused with a one-time logged warning —
+    pass a zero-arg factory for the guaranteed-clean spelling. Every attempt
+    fits with ``ckpt_path="auto"``, so attempt N+1 resumes from the
+    newest valid checkpoint attempt N managed to commit. Raises
     :class:`RetriesExhausted` when the policy runs out.
     """
 
@@ -216,11 +221,48 @@ class FitSupervisor:
 
     def fit(self, module: Any, datamodule: Any = None):
         """Returns the trainer whose fit completed."""
+        import copy
+
+        warned = False
+
+        def fresh_module():
+            # every attempt fits a deep copy of the caller's instance:
+            # the original is never attached/mutated, so attempt-1 state
+            # can't leak into attempt 2 (factories are simply called)
+            nonlocal warned
+            if callable(module):
+                return module()
+            try:
+                return copy.deepcopy(module)
+            except Exception as exc:  # noqa: BLE001 — degraded, logged
+                if not warned:
+                    warned = True
+                    log_suppressed(
+                        "supervisor.module_copy", exc,
+                        "module instance is not deep-copyable; attempts "
+                        "will reuse it as-is (a crashed attempt may leave "
+                        "poisoned state) — pass a zero-arg module factory "
+                        "for guaranteed-clean attempts")
+                return module
+
         def attempt(i: int):
             self.attempts = i
+            if i > 1:
+                self._on_retry(i)
             trainer = self.make_trainer()
-            mod = module() if callable(module) else module
-            trainer.fit(mod, datamodule=datamodule, ckpt_path="auto")
+            try:
+                trainer.fit(fresh_module(), datamodule=datamodule,
+                            ckpt_path="auto")
+            except BaseException as exc:
+                self._record_failure(exc)
+                raise
             return trainer
         return call_with_retry(attempt, self.policy, site="trainer.fit",
                                sleep=self._sleep)
+
+    # subclass hooks (GangSupervisor) ------------------------------------
+    def _on_retry(self, attempt: int) -> None:
+        """Called before each retry attempt (attempt >= 2) starts."""
+
+    def _record_failure(self, exc: BaseException) -> None:
+        """Called with each failed attempt's exception before re-raise."""

@@ -289,7 +289,7 @@ class ServeClient:
                     self._ops = max(self._ops,
                                     math.ceil(pending[idx][0]))
                 else:
-                    time.sleep(
+                    time.sleep(  # tl-lint: allow-sleep — wall-clock mode's idle yield; tick mode (clock=None) never sleeps
                         min(1e-3, max(0.0, pending[idx][0] - now)))
                 continue
             self.tick()

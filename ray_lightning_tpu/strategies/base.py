@@ -41,6 +41,7 @@ class Strategy:
                  worker_runtime_env: Optional[Dict] = None,
                  use_ray: Optional[bool] = None,
                  allow_colocated_workers: bool = False,
+                 gang: Optional[Any] = None,
                  **kwargs: Any):
         """Resource-spec semantics mirror ``ray_ddp.py:85-112``:
         ``resources_per_worker`` entries override the dedicated args —
@@ -83,6 +84,10 @@ class Strategy:
         self.init_hook = init_hook
         self.use_ray = use_ray
         self.allow_colocated_workers = allow_colocated_workers
+        # GangConfig (reliability.gang): arms worker heartbeats + the
+        # driver-side hang/death watchdog on Ray-backed launchers this
+        # strategy configures. None = the fail-fast-only fault model.
+        self.gang = gang
         self.extra_kwargs = kwargs
 
         self._mesh: Optional[Mesh] = None
@@ -114,7 +119,7 @@ class Strategy:
             return LocalLauncher(self)
         ray = _rl._import_ray()
         if ray is not None and ray.is_initialized():
-            return _rl.RayLauncher(self, ray_module=ray)
+            return _rl.RayLauncher(self, ray_module=ray, gang=self.gang)
         if self.use_ray is True:
             raise RuntimeError(
                 "use_ray=True but no Ray runtime is attached: install ray "

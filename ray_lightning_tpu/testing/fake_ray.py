@@ -212,7 +212,7 @@ class FakeRay:
                 # have finished; the rest stay in the unfinished list.
                 ready = done[:num_returns]
                 return ready, [r for r in refs if r not in ready]
-            time.sleep(0.002)
+            time.sleep(0.002)  # tl-lint: allow-sleep — ray.wait poll quantum (wall-clock by contract)
 
     # -- actors -------------------------------------------------------- #
     def remote(self, cls: type) -> FakeRemoteClass:

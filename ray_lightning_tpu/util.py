@@ -102,7 +102,9 @@ def numpy_metrics_to_device(metrics: Dict[str, Any]) -> Dict[str, Any]:
 
 def process_results(futures: List[Any],
                     queue: Optional[Any] = None,
-                    poll_interval_s: float = 0.05) -> List[Any]:
+                    poll_interval_s: float = 0.05,
+                    sleep: Callable[[float], None] = time.sleep
+                    ) -> List[Any]:
     """Drive the driver-side event loop until every worker future resolves.
 
     Parity with ``ray_lightning/util.py:57-70``: busy-poll the outstanding
@@ -112,6 +114,8 @@ def process_results(futures: List[Any],
 
     ``futures`` are executor-agnostic: anything with ``.done()``/``.result()``
     (concurrent.futures) or resolved via the installed executor backend.
+    ``sleep`` is injectable (the package sleep-lint contract) so tests can
+    drive the poll loop without wall time.
     """
     pending = list(futures)
     while pending:
@@ -124,7 +128,7 @@ def process_results(futures: List[Any],
         if not not_done:
             break
         pending = not_done
-        time.sleep(poll_interval_s)
+        sleep(poll_interval_s)
     _drain_queue(queue)
     return [_future_result(f) for f in futures]
 

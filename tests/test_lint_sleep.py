@@ -1,0 +1,77 @@
+"""AST lint: library code contains no direct ``time.sleep()``.
+
+Sibling of ``test_lint_print.py`` / ``test_lint_exceptions.py``. A
+blocking wall-clock sleep hard-wired into library code makes every test
+that crosses it pay real seconds and makes chaos/recovery behavior
+untestable deterministically. The sanctioned spellings:
+
+- an **injectable** ``sleep``/clock parameter (as ``retry.py``'s
+  ``call_with_retry(..., sleep=time.sleep)`` and ``FaultPlan``'s
+  constructor do) — referencing ``time.sleep`` as a *default value* is
+  fine, calling it directly is not; tests then inject a no-op and stay
+  wall-clock-free. This is what keeps the gang-restart tests
+  deterministic;
+- an explicit ``tl-lint: allow-sleep`` marker on the call line with a
+  justification — reserved for genuinely wall-clock code (backend poll
+  quanta inside ``ray.wait``-parity loops, the serve client's wall-mode
+  idle yield).
+
+``examples/`` and ``tools/`` live outside the package and are not
+linted; ``from time import sleep`` is rejected outright (it launders the
+call into a bare name the AST check cannot distinguish from an injected
+parameter).
+"""
+import ast
+import pathlib
+
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "ray_lightning_tpu"
+
+MARKER = "tl-lint: allow-sleep"
+
+
+def _direct_sleep_calls(tree):
+    """Line numbers of ``time.sleep(...)`` calls (any ``<mod>.sleep`` where
+    the receiver is a bare name ``time``)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "sleep" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "time":
+            out.append(node.lineno)
+    return out
+
+
+def _sleep_imports(tree):
+    """``from time import sleep`` lines (aliased or not)."""
+    return [
+        node.lineno for node in ast.walk(tree)
+        if isinstance(node, ast.ImportFrom) and node.module == "time"
+        and any(alias.name == "sleep" for alias in node.names)
+    ]
+
+
+@pytest.mark.parametrize(
+    "path", sorted(PKG.rglob("*.py")), ids=lambda p: str(p.relative_to(PKG)))
+def test_no_direct_time_sleep_in_library_code(path):
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    offenders = [
+        f"{path.relative_to(PKG.parent)}:{lineno}"
+        for lineno in _direct_sleep_calls(tree)
+        if MARKER not in lines[lineno - 1]
+    ]
+    offenders += [
+        f"{path.relative_to(PKG.parent)}:{lineno} (from time import sleep)"
+        for lineno in _sleep_imports(tree)
+    ]
+    assert not offenders, (
+        "direct time.sleep() in library code — take an injectable "
+        "`sleep: Callable[[float], None] = time.sleep` parameter (the "
+        "retry.py pattern; tests inject a no-op and stay "
+        "wall-clock-free), or mark genuinely wall-clock code with "
+        f"`# {MARKER} — <why>`: " + ", ".join(offenders))

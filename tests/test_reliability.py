@@ -436,6 +436,39 @@ def test_fit_supervisor_retries_to_completion(tmp_root):
                   jax.device_get(trainer.train_state.params))
 
 
+class _SelfPoisoningModel(BoringModel):
+    """Mutates its own state during the attempt — the way a real module
+    can be left half-configured/poisoned by a crash."""
+
+    def on_train_start(self):
+        if getattr(self, "poisoned", False):
+            raise RuntimeError("poisoned module state leaked into retry")
+        self.poisoned = True
+
+
+def test_fit_supervisor_deepcopies_module_instance(tmp_root):
+    """ISSUE 5 satellite: a module passed as an *instance* must not carry
+    attempt-1 mutations into attempt 2 — each attempt fits a deep copy of
+    the pristine module. (Before the fix the instance was reused as-is and
+    this fit raised 'poisoned module state leaked'.)"""
+    ck = os.path.join(tmp_root, "ck")
+
+    def make_trainer():
+        return _trainer(tmp_root, limit_val_batches=0,
+                        callbacks=[ModelCheckpoint(dirpath=ck)])
+
+    sup = FitSupervisor(make_trainer,
+                        RetryPolicy(max_attempts=3, base_delay=0.0),
+                        sleep=lambda s: None)
+    module = _SelfPoisoningModel()
+    with FaultPlan.at("train.step", [5]).armed():
+        trainer = sup.fit(module)  # instance, not factory
+    assert sup.attempts == 2
+    assert trainer.state == "finished"
+    # the caller's instance was never touched by any attempt
+    assert not getattr(module, "poisoned", False)
+
+
 def test_serve_supervisor_delegates_engine_surface(nano):
     """The supervisor quacks like the engine for the scheduler/bench
     probes, and swaps in a fresh engine object across a rebuild."""
