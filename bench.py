@@ -1119,10 +1119,10 @@ def _bench_chaos(num_slots: int = 4, n_requests: int = 8,
 
 
 def _bench_gang() -> dict:
-    """Gang kill-and-restart cost on the process backend.
+    """Gang kill-and-restart cost on the process backend: cold vs warm.
 
     One OS-process worker fits a BoringModel (3 epochs x 4 batches)
-    under :class:`GangSupervisor` twice: clean, then with a pinned
+    under :class:`GangSupervisor`: clean, then with a pinned
     ``worker.exit`` fault hard-killing the worker at batch tick 9 of 12
     — inside the final epoch (``os._exit``, the OOM/preemption death).
     The supervisor detects the dead actor, tears the gang down,
@@ -1130,9 +1130,17 @@ def _bench_gang() -> dict:
     (end-of-epoch) checkpoint, re-running only the last epoch.
     ``gang_recovery_ms`` is the extra wall the faulted run pays over the
     clean one — detection + teardown + respawn (interpreter/jax cold
-    start dominates) + the ~1-epoch resume. Untracked (no regression
-    gate): spawn cost is environment noise; recorded for trend
-    visibility.
+    start dominates) + the ~1-epoch resume.
+
+    The **warm** pair repeats both runs with a prefilled
+    :class:`StandbyPool` (2 standbys — the restart's rank slot is
+    guaranteed a warm promotion, no refill race): the recovery path
+    pays promotion instead of actor spawn, so ``gang_recovery_warm_ms``
+    should be bounded by detection + teardown + the 1-epoch resume —
+    the "no actor-spawn on the critical path" claim (the background
+    refill overlaps the resumed epoch and is excluded by stopping the
+    timer before pool shutdown). Untracked (no regression gate): spawn
+    cost is environment noise; recorded for trend visibility.
     """
     import shutil
     import tempfile
@@ -1141,9 +1149,10 @@ def _bench_gang() -> dict:
                                    ModelCheckpoint, RayStrategy,
                                    RetryPolicy, Trainer)
     from ray_lightning_tpu.launchers.process_backend import ProcessRay
-    from ray_lightning_tpu.launchers.ray_launcher import RayLauncher
+    from ray_lightning_tpu.launchers.ray_launcher import (ExecutorBase,
+                                                          RayLauncher)
     from ray_lightning_tpu.models import BoringModel
-    from ray_lightning_tpu.reliability import FaultPlan
+    from ray_lightning_tpu.reliability import FaultPlan, StandbyPool
 
     worker_env = {
         "JAX_PLATFORMS": "cpu",
@@ -1151,10 +1160,15 @@ def _bench_gang() -> dict:
         "PALLAS_AXON_POOL_IPS": "",
     }
 
-    def run(plan):
+    def run(plan, num_standby=0):
         root = tempfile.mkdtemp(prefix="tl_bench_gang_")
         ray_mod = ProcessRay(worker_env=dict(worker_env))
         ray_mod.init()
+        pool = None
+        if num_standby:
+            pool = StandbyPool(ray_mod, num_standby=num_standby)
+            pool.fill(lambda: ray_mod.remote(
+                ExecutorBase).options().remote())
 
         def make_trainer():
             strategy = RayStrategy(num_workers=1)
@@ -1166,12 +1180,12 @@ def _bench_gang() -> dict:
                 default_root_dir=root)
             trainer._launcher = RayLauncher(
                 strategy, ray_module=ray_mod,
-                gang=GangConfig(heartbeat_timeout=120.0))
+                gang=GangConfig(heartbeat_timeout=120.0), standby=pool)
             return trainer
 
         sup = GangSupervisor(make_trainer,
                              RetryPolicy(max_attempts=3, base_delay=0.0),
-                             sleep=lambda s: None)
+                             sleep=lambda s: None, standby=pool)
         t0 = time.perf_counter()
         try:
             if plan is None:
@@ -1179,20 +1193,31 @@ def _bench_gang() -> dict:
             else:
                 with plan.armed():
                     sup.fit(BoringModel)
+            elapsed = time.perf_counter() - t0  # refill tail excluded
         finally:
+            if pool is not None:
+                pool.shutdown()
             ray_mod.shutdown()
             shutil.rmtree(root, ignore_errors=True)
-        return time.perf_counter() - t0, sup
+        return elapsed, sup, pool
 
-    clean_s, _ = run(None)
-    fault_s, sup = run(FaultPlan.at("worker.exit", [9], mode="exit"))
+    plan = lambda: FaultPlan.at("worker.exit", [9], mode="exit")  # noqa: E731
+    clean_s, _, _ = run(None)
+    fault_s, sup, _ = run(plan())
     if sup.restarts != 1 or not sup.failures:
         raise MeasurementError(
             f"gang scenario expected exactly 1 restart, saw "
             f"{sup.restarts} (failures: {len(sup.failures)}) — the "
             "pinned fault tick no longer lands past the last "
             "epoch-boundary checkpoint")
-    return {
+    warm_clean_s, _, _ = run(None, num_standby=2)
+    warm_fault_s, warm_sup, warm_pool = run(plan(), num_standby=2)
+    if warm_sup.restarts != 1 or warm_pool.promotions < 2:
+        raise MeasurementError(
+            f"warm gang scenario expected 1 restart with a warm "
+            f"promotion, saw restarts={warm_sup.restarts} "
+            f"promotions={warm_pool.promotions}")
+    out = {
         "backend": "process (1 OS-process worker, CPU)",
         "fault": "worker.exit tick 9 of 12 (os._exit in the final epoch)",
         "restarts": sup.restarts,
@@ -1201,7 +1226,118 @@ def _bench_gang() -> dict:
         "faultfree_fit_s": round(clean_s, 2),
         "faulted_fit_s": round(fault_s, 2),
         "gang_recovery_ms": round(1e3 * max(0.0, fault_s - clean_s), 1),
+        "standby_promotions": warm_pool.promotions,
+        "warm_faultfree_fit_s": round(warm_clean_s, 2),
+        "warm_faulted_fit_s": round(warm_fault_s, 2),
+        "gang_recovery_warm_ms": round(
+            1e3 * max(0.0, warm_fault_s - warm_clean_s), 1),
     }
+    try:
+        out["elastic"] = _run_gang_elastic_child()
+    except Exception as exc:  # the elastic sub-scenario degrades alone
+        out["elastic"] = {"error": f"{type(exc).__name__}: {exc}"}
+    return out
+
+
+def _gang_elastic_child() -> None:
+    """N→M elastic-resume cost, in a forced-8-CPU-device child.
+
+    A 4-way FSDP fit (params + optimizer state sharded over ``fsdp=4``)
+    saves an epoch-boundary checkpoint; losing half the capacity is then
+    simulated by resuming the SAME checkpoint at world size 2 — build
+    trainer, re-shard-restore, re-run the final epoch.
+    ``gang_recovery_elastic_ms`` is that resume's wall; the 4-way resume
+    of the identical checkpoint is the same-size baseline, so the
+    difference isolates what shrinking the world actually costs
+    (re-shard placement + the smaller mesh's step). Restored params are
+    verified element-identical to the checkpoint before timing counts.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from flax import serialization
+    from ray_lightning_tpu import FSDPStrategy, ModelCheckpoint, Trainer
+    from ray_lightning_tpu.core.checkpoint import (find_resume_candidates,
+                                                   load_sharded_checkpoint)
+    from ray_lightning_tpu.models import BoringModel
+
+    root = tempfile.mkdtemp(prefix="tl_bench_elastic_")
+    ck = os.path.join(root, "ck")
+
+    def make(world, max_epochs):
+        return Trainer(strategy=FSDPStrategy(num_workers=world,
+                                             use_tpu=False),
+                       max_epochs=max_epochs, seed=0,
+                       limit_train_batches=4, limit_val_batches=0,
+                       callbacks=[ModelCheckpoint(dirpath=ck,
+                                                  save_format="orbax")],
+                       default_root_dir=root)
+
+    try:
+        make(4, 2).fit(BoringModel())
+        path = find_resume_candidates(ck)[0]
+        host = load_sharded_checkpoint(path)
+
+        def resume(world):
+            t0 = time.perf_counter()
+            trainer = make(world, 3)
+            trainer.fit(BoringModel(), ckpt_path=path)
+            jax.block_until_ready(trainer.train_state.params)
+            return time.perf_counter() - t0, trainer
+
+        # honesty gate FIRST, on a pure restore (no epochs left to
+        # train): the 2-way re-shard must hold the checkpoint's exact
+        # values before its resume time means anything
+        chk = make(2, 2)
+        chk.fit(BoringModel(), ckpt_path=path)
+        restored = serialization.to_state_dict(
+            jax.device_get(chk.train_state))["params"]
+        saved = host["state"]["params"]
+        mism = sum(
+            int(not np.array_equal(a, b))
+            for a, b in zip(jax.tree_util.tree_leaves(saved),
+                            jax.tree_util.tree_leaves(restored)))
+        same_s, _ = resume(4)
+        elastic_s, _t2 = resume(2)
+        print(json.dumps({
+            "world": "save 4-way, resume 2-way (+1 epoch)",
+            "gang_recovery_elastic_ms": round(1e3 * elastic_s, 1),
+            "same_size_resume_ms": round(1e3 * same_s, 1),
+            "reshard_param_leaves_mismatched": mism,
+        }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _run_gang_elastic_child() -> dict:
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["_TL_BENCH_MODE"] = "gang_elastic"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True,
+                          timeout=900)
+    if proc.returncode != 0:
+        raise MeasurementError(
+            f"gang_elastic child failed rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if out.get("reshard_param_leaves_mismatched", 1) != 0:
+            raise MeasurementError(
+                "elastic resume did not restore the checkpoint "
+                f"element-identically: {out}")
+        return out
+    raise MeasurementError("gang_elastic child printed no JSON")
 
 
 def _bench_obs(num_slots: int = 4, n_requests: int = 8,
@@ -1585,6 +1721,9 @@ def main() -> None:
         return
     if mode == "data":
         print(json.dumps(_bench_data_pipeline()))
+        return
+    if mode == "gang_elastic":
+        _gang_elastic_child()
         return
 
     extras: dict = {}

@@ -109,7 +109,8 @@ class ModelCheckpoint(Callback):
                  save_last: bool = False,
                  save_format: str = "stream",
                  async_save: bool = False,
-                 every_n_train_steps: int = 0):
+                 every_n_train_steps: int = 0,
+                 keep_last_n: Optional[int] = None):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
         if save_format not in ("stream", "orbax"):
@@ -122,6 +123,10 @@ class ModelCheckpoint(Callback):
             raise ValueError(
                 f"every_n_train_steps must be >= 0, got "
                 f"{every_n_train_steps}")
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(
+                f"keep_last_n must be >= 1 (the newest committed "
+                f"checkpoint is never pruned), got {keep_last_n}")
         self.dirpath = dirpath
         self.filename = filename
         self.monitor = monitor
@@ -136,6 +141,12 @@ class ModelCheckpoint(Callback):
         # loader instead of replaying or skipping the half-epoch). 0 =
         # epoch-end saves only.
         self.every_n_train_steps = every_n_train_steps
+        # retention for long chaos runs: after each save, prune committed
+        # checkpoints beyond the newest keep_last_n (tmp-safe and
+        # marker-aware — see core.checkpoint.prune_checkpoints; the
+        # best/top-k ledger and 'last' are always protected). None = keep
+        # everything the top-k ledger doesn't already prune.
+        self.keep_last_n = keep_last_n
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self.last_model_path: str = ""
@@ -237,6 +248,7 @@ class ModelCheckpoint(Callback):
                 else:
                     os.remove(prev)
             self._last_periodic_path = path
+            self._retention_prune()
             return
         score = monitor_val if monitor_val is not None else \
             -float(trainer.global_step)  # no monitor: newest is best
@@ -251,6 +263,32 @@ class ModelCheckpoint(Callback):
         if self.save_last:
             self.last_model_path = os.path.join(self.dirpath,
                                                 "last" + suffix)
+        self._retention_prune()
+
+    def _retention_prune(self) -> None:
+        """``keep_last_n`` retention: bound what long chaos runs leave in
+        the checkpoint dir. Everything the callback still tracks (top-k
+        ledger, best, 'last', the rolling periodic save) is protected —
+        recency pruning must never delete a path the metric ledger
+        would hand out."""
+        if not self.keep_last_n or not self.dirpath:
+            return
+        if self.async_save and jax.process_count() > 1:
+            # multi-host: other hosts' commit progress is unobservable
+            # from here, so rank 0 must drain before deleting anything
+            # (never rmtree across an unobserved async commit barrier).
+            # Single-host needs no barrier: AsyncCheckpointer serializes
+            # saves, so the only possibly-in-flight dir is
+            # _last_saved_path — protected below — and a full wait per
+            # save would serialize the loop async_save exists to overlap.
+            from ray_lightning_tpu.core.checkpoint import \
+                wait_for_async_saves
+            wait_for_async_saves()
+        from ray_lightning_tpu.core.checkpoint import prune_checkpoints
+        protect = {p for _s, p in self._saved}
+        protect.update({self.best_model_path, self.last_model_path,
+                        self._last_periodic_path, self._last_saved_path})
+        prune_checkpoints(self.dirpath, self.keep_last_n, protect=protect)
 
     def _prune(self) -> None:
         if self.save_top_k < 0:

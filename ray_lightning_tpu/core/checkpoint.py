@@ -338,7 +338,35 @@ def is_sharded_checkpoint(path: str) -> bool:
     return os.path.isdir(path)
 
 
-def _step_of(path: str) -> int:
+def reshard_state(template: Any, restored: Any, shardings: Any) -> Any:
+    """Place a restored host state onto the CURRENT mesh — the
+    save-N-way / restore-M-way seat of elastic recovery.
+
+    ``template`` is a live train state with the resuming run's
+    structure (its values are discarded), ``restored`` the host-numpy
+    state dict a checkpoint loader produced, ``shardings`` the resuming
+    strategy's sharding pytree. Because every loader in this module
+    returns *full* host arrays (orbax restores are forced to host numpy
+    precisely so the saving run's device layout never leaks —
+    ``_restore_numpy``), re-sharding is one ``device_put`` under the new
+    rules: a checkpoint written 4-way restores 2-way (or 8-way) with
+    element-identical params AND optimizer state, which the elastic
+    tests pin. Raises :class:`CorruptCheckpointError` when the restored
+    tree cannot adopt the template's structure (a genuinely foreign
+    checkpoint), so ``resume="auto"`` can fall back to an older
+    candidate instead of crashing the restart.
+    """
+    try:
+        host = serialization.from_state_dict(jax.device_get(template),
+                                             restored)
+    except (KeyError, TypeError, ValueError) as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint state does not match the resuming run's state "
+            f"structure: {type(exc).__name__}: {exc}") from exc
+    return jax.device_put(host, shardings)
+
+
+def step_of(path: str) -> int:
     """Parse the ``step=N`` our ModelCheckpoint naming embeds, else -1."""
     name = os.path.basename(path)
     for part in name.replace(".ckpt", "").replace(".orbax", "").split("-"):
@@ -350,7 +378,68 @@ def _step_of(path: str) -> int:
     return -1
 
 
-def find_resume_candidates(root: str) -> List[str]:
+# back-compat alias (pre-elastic private spelling)
+_step_of = step_of
+
+
+def is_committed_checkpoint(path: str) -> bool:
+    """True when ``path`` is a *committed* checkpoint: a stream file, or
+    a directory carrying the ``tl_meta.msgpack`` commit marker. A
+    marker-less directory is an in-flight or interrupted save and must
+    never be treated as prunable data (an async orbax commit may still
+    be writing it)."""
+    if os.path.isdir(path):
+        return os.path.exists(os.path.join(path, _META_NAME))
+    return path.endswith(".ckpt")
+
+
+def prune_checkpoints(root: str, keep_last_n: int,
+                      protect: Any = ()) -> List[str]:
+    """Delete committed checkpoints beyond the newest ``keep_last_n``.
+
+    Retention for long chaos runs: repeated crash/restart cycles save a
+    checkpoint per epoch (plus periodic mid-epoch saves) and never
+    delete — this prunes the tail. Safety rails:
+
+    - only **committed** candidates are touched
+      (:func:`is_committed_checkpoint`): staging dirs (``*.tmp-*``) are
+      never even candidates, and marker-less directories (possibly an
+      in-flight async commit) are left alone;
+    - the newest ``keep_last_n`` committed candidates always survive
+      (``keep_last_n >= 1``), so the newest committed checkpoint is
+      never pruned;
+    - any path in ``protect`` (e.g. a ModelCheckpoint's best/top-k
+      ledger) survives regardless of age.
+
+    Returns the paths actually deleted.
+    """
+    if keep_last_n < 1:
+        raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+    protected = {os.path.abspath(p) for p in protect if p}
+    committed = [p for p in find_resume_candidates(root)
+                 if is_committed_checkpoint(p)]
+    doomed = [p for p in committed[keep_last_n:]
+              if os.path.abspath(p) not in protected]
+    deleted = []
+    for path in doomed:
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+        except OSError as exc:
+            # still on disk, still a valid resume fallback: it must NOT
+            # be reported deleted (find_resume_candidates filters the
+            # returned paths out of the candidate list)
+            log_suppressed("ckpt.prune", exc,
+                           f"could not prune old checkpoint {path}")
+        else:
+            deleted.append(path)
+    return deleted
+
+
+def find_resume_candidates(root: str,
+                           keep_last_n: Optional[int] = None) -> List[str]:
     """Checkpoint candidates under ``root``, best-first.
 
     Ordered by the ``step=N`` embedded in our checkpoint filenames
@@ -358,6 +447,11 @@ def find_resume_candidates(root: str) -> List[str]:
     names. Staging dirs (``*.tmp-*``) are never candidates. The caller
     (``resume="auto"``) tries each in turn and skips the ones that raise
     :class:`CorruptCheckpointError`.
+
+    ``keep_last_n`` additionally prunes committed candidates beyond the
+    newest ``keep_last_n`` before returning (see
+    :func:`prune_checkpoints` for the safety rails — the newest
+    committed candidate is never pruned).
     """
     if not os.path.isdir(root):
         return []
@@ -368,6 +462,9 @@ def find_resume_candidates(root: str) -> List[str]:
         path = os.path.join(root, name)
         if os.path.isdir(path) or name.endswith(".ckpt"):
             out.append(path)
-    out.sort(key=lambda p: (_step_of(p), os.path.getmtime(p), p),
+    out.sort(key=lambda p: (step_of(p), os.path.getmtime(p), p),
              reverse=True)
+    if keep_last_n is not None:
+        pruned = set(prune_checkpoints(root, keep_last_n))
+        out = [p for p in out if p not in pruned]
     return out

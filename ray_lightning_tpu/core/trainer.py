@@ -382,9 +382,12 @@ class Trainer:
             init_fn, out_shardings=state_shardings)(init_rng, device_batch)
 
         if restored is not None:
-            host_state = serialization.from_state_dict(
-                jax.device_get(state), restored)
-            state = jax.device_put(host_state, state_shardings)
+            # re-shard on restore: the loaders hand back FULL host
+            # arrays, so a checkpoint saved N-way lands on this run's
+            # (possibly different-sized) mesh in one device_put — the
+            # elastic save-N-way / restore-M-way contract
+            from ray_lightning_tpu.core.checkpoint import reshard_state
+            state = reshard_state(state, restored, state_shardings)
 
         def loss_fn(params, model_state, batch, rng):
             variables = {"params": params, **model_state}
@@ -452,6 +455,20 @@ class Trainer:
         start_epoch = 0
         self._resume_skip = 0
         if restored_ckpt is not None:
+            saved_world = int(
+                (restored_ckpt.get("world") or {}).get("world_size") or 0)
+            if saved_world and saved_world != self.strategy.num_workers \
+                    and self.telemetry is not None:
+                from ray_lightning_tpu.reliability.elastic import (
+                    COUNTER_RESHARDS, EVENT_CKPT_RESHARD)
+                self.telemetry.event(
+                    EVENT_CKPT_RESHARD, from_world=saved_world,
+                    to_world=self.strategy.num_workers,
+                    global_step=int(restored_ckpt.get("global_step", 0)))
+                self.telemetry.metrics.counter(
+                    COUNTER_RESHARDS,
+                    help="checkpoints re-sharded onto a different world "
+                         "size on restore").inc()
             saved_epoch = int(restored_ckpt.get("epoch", -1))
             # mid-epoch checkpoints (periodic every_n_train_steps saves)
             # record how many batches of `saved_epoch` were done; resume
@@ -741,8 +758,9 @@ class Trainer:
         return jax.device_put(host, self._state_shardings)
 
     def _resolve_auto_resume(self):
-        """``resume="auto"``: newest *valid* checkpoint in the run's
-        checkpoint dir, or ``(None, None)`` for a fresh start.
+        """``resume="auto"``: newest *valid* checkpoint — in-memory tier
+        first, then the on-disk scan — or ``(None, None)`` for a fresh
+        start.
 
         Only corruption-class errors (``CorruptCheckpointError``, I/O and
         decode failures) skip to an older candidate — a programming error
@@ -753,7 +771,11 @@ class Trainer:
         ckpt_cb = self.checkpoint_callback
         root = ckpt_cb.dirpath if ckpt_cb is not None and ckpt_cb.dirpath \
             else os.path.join(self.default_root_dir, "checkpoints")
-        for path in find_resume_candidates(root):
+        candidates = find_resume_candidates(root)
+        mem = self._memory_resume(candidates)
+        if mem is not None:
+            return mem
+        for path in candidates:
             try:
                 return path, self._read_checkpoint(path)
             except (CorruptCheckpointError, OSError, EOFError,
@@ -762,6 +784,55 @@ class Trainer:
                     "ckpt.load", exc,
                     f"resume='auto' skipping corrupt candidate {path}")
         return None, None
+
+    def _memory_resume(self, disk_candidates):
+        """The in-memory checkpoint tier of ``resume="auto"``.
+
+        When a :class:`~ray_lightning_tpu.reliability.elastic
+        .MemoryCheckpointStore` (or its worker-side client) is
+        installed, its candidates are consulted AHEAD of disk: resume
+        cost stops scaling with checkpoint storage. Disk still wins
+        when it holds strictly newer progress — the memory tier (or its
+        ring buddy) can die with the host while the disk copy survives,
+        and resuming from a stale memory snapshot would silently lose
+        committed steps. Uninstalled store = one global read + ``None``
+        check."""
+        from ray_lightning_tpu.reliability import elastic as _elastic
+        store = _elastic.get_memory_store()
+        if store is None:
+            return None
+        from ray_lightning_tpu.core.checkpoint import step_of
+        disk_best = step_of(disk_candidates[0]) if disk_candidates else -1
+        if disk_candidates and disk_best < 0:
+            # disk checkpoints exist but their names carry no step= we
+            # can order against — we cannot prove the memory tier is not
+            # stale (its channel may have dropped commits while disk
+            # advanced), and resuming stale RAM would silently roll back
+            # committed progress. Disk wins.
+            return None
+        # copy lazily: only the one candidate actually restored is
+        # copied — eager copies of every held multi-GB state would
+        # double peak host RAM for nothing
+        for step, ckpt in store.resume_candidates(copy_payloads=False):
+            if step < disk_best:
+                break  # disk holds newer committed progress
+            if not isinstance(ckpt, dict) or ckpt.get("state") is None:
+                log_suppressed(
+                    "ckpt.memory",
+                    ValueError(f"malformed in-memory candidate at "
+                               f"step {step}"),
+                    "skipping to the next memory candidate")
+                continue
+            import copy as _copy
+            ckpt = _copy.deepcopy(ckpt)  # callbacks/restore may mutate
+            for cb in self.callbacks:
+                cb.on_load_checkpoint(self, self._module, ckpt)
+            if self.telemetry is not None:
+                from ray_lightning_tpu.reliability.elastic import \
+                    EVENT_MEMORY_RESUME
+                self.telemetry.event(EVENT_MEMORY_RESUME, step=step)
+            return f"<memory:step={step}>", ckpt
+        return None
 
     def _run_validation(self, val_loader, module, limit=None):
         module.on_validation_epoch_start()
@@ -1109,6 +1180,7 @@ class Trainer:
             save_sharded_checkpoint(filepath, ckpt, self.train_state,
                                     async_save=async_save)
             self._last_ckpt_path = filepath
+            self._memory_checkpoint(ckpt)
             return
         ckpt = self.dump_checkpoint()
         os.makedirs(os.path.dirname(os.path.abspath(filepath)), exist_ok=True)
@@ -1124,6 +1196,32 @@ class Trainer:
             if os.path.exists(tmp):  # failed before the rename: no litter
                 os.remove(tmp)
         self._last_ckpt_path = filepath
+        self._memory_checkpoint(ckpt)
+
+    def _memory_checkpoint(self, ckpt: Dict[str, Any]) -> None:
+        """Mirror a just-committed checkpoint into the in-memory tier.
+
+        Runs AFTER the disk commit (the memory entry must never be the
+        only copy of progress disk doesn't have) and only when a
+        :class:`~ray_lightning_tpu.reliability.elastic
+        .MemoryCheckpointStore`/client is installed — otherwise this is
+        one global read + ``None`` check. Best-effort by design: a
+        state that cannot be host-gathered (multi-host non-addressable
+        shards) skips the memory tier with a logged suppression and the
+        disk copy stands alone."""
+        from ray_lightning_tpu.reliability import elastic as _elastic
+        store = _elastic.get_memory_store()
+        if store is None:
+            return
+        try:
+            payload = jax.device_get(ckpt)
+            store.put(int(self.global_step), payload,
+                      rank=self.strategy.global_rank,
+                      world_size=self.strategy.num_workers)
+        except Exception as exc:  # noqa: BLE001 — memory tier is best-effort
+            log_suppressed("ckpt.memory", exc,
+                           "in-memory checkpoint skipped; the disk copy "
+                           "is intact")
 
     def dump_checkpoint(self, consolidate: bool = True) -> Dict[str, Any]:
         module_state: Dict[str, Any] = {}
@@ -1136,6 +1234,10 @@ class Trainer:
             # lets resume="auto" fast-forward the dataloader instead of
             # skipping the rest of a half-trained epoch
             "loop": {"batch_in_epoch": int(self._batch_in_epoch)},
+            # the saving world's size: restore compares it against the
+            # resuming world and emits ckpt.reshard on a mismatch (the
+            # state itself re-shards via full host arrays either way)
+            "world": {"world_size": int(self.strategy.num_workers)},
             "state": serialization.to_state_dict(
                 jax.device_get(self._consolidated_state()) if consolidate
                 else self.train_state),

@@ -385,6 +385,12 @@ class ProcessRay:
         actor._kill()
         self.killed_actors.append(actor)
 
+    def live_actor_count(self) -> int:
+        """Spawned actor processes still alive — the no-leak assertion
+        seat: after fit teardown plus standby-pool shutdown, every
+        channel/store/pool teardown path must leave this at zero."""
+        return sum(1 for a in self.created_actors if a._proc.is_alive())
+
     # -- launcher extension: cross-process tune queue ------------------- #
     def make_queue(self) -> _ManagerQueue:
         if self._manager is None:

@@ -138,7 +138,8 @@ class RayLauncher:
 
     def __init__(self, strategy, ray_module: Any = None,
                  workers: Optional[List[Any]] = None,
-                 gang: Optional[Any] = None):
+                 gang: Optional[Any] = None,
+                 standby: Optional[Any] = None):
         """``workers``: externally-owned executor actors to reuse instead
         of creating (and killing) a fresh set per ``launch()``. The
         caller owns their lifetime. Consecutive fits skip actor spawn +
@@ -158,6 +159,17 @@ class RayLauncher:
         wedged in a collective never exit on their own). ``None`` (the
         default) keeps the fail-fast-only fault model with zero added
         cost.
+
+        ``standby``: a
+        :class:`~ray_lightning_tpu.reliability.elastic.StandbyPool` of
+        pre-warmed executor actors. ``setup_workers`` *promotes* a
+        standby into each rank slot it can (``standby.promoted`` event)
+        before spawning cold, and the pool is topped back up on a
+        background thread right after dispatch — so a gang restart's
+        critical path pays promotion, never actor spawn + interpreter +
+        jax import. The pool is caller-owned: it survives full-gang
+        teardown by design (that is its whole point) and the caller
+        must ``pool.shutdown()`` when done.
         """
         self._strategy = strategy
         self._ray = ray_module if ray_module is not None else _import_ray()
@@ -189,6 +201,10 @@ class RayLauncher:
         self._gang_monitor: Any = None
         self._gang_failed = False
         self._tel: Any = None  # driver-side telemetry, captured per launch
+        # elastic recovery seams (None = disarmed, zero cost)
+        self._standby = standby
+        self._memstore_channel: Any = None
+        self._memstore_driver: Any = None  # store captured at setup time
 
     @property
     def is_interactive_compatible(self) -> bool:
@@ -241,10 +257,26 @@ class RayLauncher:
         else:
             if strategy.use_tpu and not strategy.allow_colocated_workers:
                 self._check_enough_tpu_hosts()
-            self._workers = [
-                self._create_worker(rank)
-                for rank in range(strategy.num_workers)
-            ]
+            # standby promotion: fill rank slots from the warm pool
+            # first — a restart with enough standbys pays zero actor
+            # spawn on its critical path (the pool refills in the
+            # background after dispatch)
+            self._workers = []
+            for rank in range(strategy.num_workers):
+                worker = None if self._standby is None \
+                    else self._standby.take()
+                if worker is not None and self._tel is not None:
+                    from ray_lightning_tpu.reliability.elastic import (
+                        COUNTER_STANDBY_PROMOTIONS, EVENT_STANDBY_PROMOTED)
+                    self._tel.event(EVENT_STANDBY_PROMOTED, rank=rank,
+                                    available=self._standby.available())
+                    self._tel.metrics.counter(
+                        COUNTER_STANDBY_PROMOTIONS,
+                        help="warm standby workers promoted into gang "
+                             "rank slots").inc()
+                if worker is None:
+                    worker = self._create_worker(rank)
+                self._workers.append(worker)
         if strategy.init_hook:
             self._ray.get([
                 w.execute.remote(strategy.init_hook) for w in self._workers
@@ -283,6 +315,17 @@ class RayLauncher:
             self._gang_monitor = GangMonitor(
                 strategy.num_workers, self._gang, node_ips=node_ips,
                 telemetry=self._tel)
+
+        # in-memory checkpoint replication: when a store is installed on
+        # the driver, workers ship commits back over their own channel
+        # (drained by the watchdog poll) and each dispatch carries the
+        # current resume candidates out. The store reference is captured
+        # HERE so in-process fake workers swapping the global seat for
+        # their client can never race the driver's drain.
+        from ray_lightning_tpu.reliability import elastic as _elastic
+        self._memstore_driver = _elastic.get_memory_store()
+        if self._memstore_driver is not None:
+            self._memstore_channel = self._make_queue_channel()
 
         self.queue = None
         if tune_enabled and self._in_tune_session():
@@ -523,13 +566,32 @@ class RayLauncher:
             return HeartbeatEmitter(self._gang_channel, rank,
                                     interval=self._gang.heartbeat_interval)
 
+        # in-memory checkpoint tier: ship the replication channel plus
+        # the driver store's CURRENT resume candidates with the
+        # dispatch, so a restarted worker resumes from RAM without
+        # touching checkpoint storage (disk stays the fallback)
+        memstore_ship = None
+        if self._memstore_channel is not None \
+                and self._memstore_driver is not None:
+            memstore_ship = {
+                "channel": self._memstore_channel,
+                "world_size": num_workers,
+                # no eager copy: the dispatch pickle below IS the copy
+                "candidates": self._memstore_driver.resume_candidates(
+                    copy_payloads=False),
+            }
+
         futures = [
             w.execute.remote(self._wrapping_function, rank, global_to_local,
                              trainer_ref, fn_name, args, kwargs, coordinator,
                              num_workers, queue, _heartbeat_for(rank),
-                             fault_plan)
+                             fault_plan, memstore_ship)
             for rank, w in enumerate(self._workers)
         ]
+        if self._standby is not None:
+            # top the pool back up OFF the critical path: the gang is
+            # already dispatched and training while replacements warm
+            self._standby.refill_async(lambda: self._create_worker(-1))
         results = self._process_results(futures, queue)
         return results[0]
 
@@ -537,7 +599,8 @@ class RayLauncher:
     def _wrapping_function(global_rank: int, global_to_local, trainer_ref,
                            fn_name: str, args, kwargs, coordinator: str,
                            num_processes: int, queue, heartbeat=None,
-                           fault_plan=None) -> Optional[Any]:
+                           fault_plan=None,
+                           memstore=None) -> Optional[Any]:
         """Worker-side entry (parity: ``ray_launcher.py:253-311``):
         deserialize trainer, wire ranks/session, initialize the distributed
         runtime, run the real work, return rank-0's output only.
@@ -546,7 +609,14 @@ class RayLauncher:
         :class:`~ray_lightning_tpu.reliability.gang.HeartbeatEmitter`
         back to the driver's watchdog; ``fault_plan`` is the driver's
         armed chaos schedule, armed here too so remote workers inject
-        the same failures an in-process fit would."""
+        the same failures an in-process fit would; ``memstore`` (when an
+        in-memory checkpoint store is installed driver-side) carries the
+        replication channel plus the shipped resume candidates — a
+        worker-side
+        :class:`~ray_lightning_tpu.reliability.elastic
+        .MemoryCheckpointClient` is installed for the duration (and the
+        previous global occupant restored after, so in-process fake
+        workers never clobber the driver's store)."""
         trainer = trainer_ref
         if hasattr(trainer_ref, "_is_fake_object_ref"):
             trainer = trainer_ref.value  # in-process fake store (tests)
@@ -558,6 +628,18 @@ class RayLauncher:
         from ray_lightning_tpu.reliability import faults as _faults
         armed_here = (fault_plan is not None
                       and _faults.ensure_armed(fault_plan))
+        prev_store = None
+        store_installed = False
+        if memstore is not None:
+            from ray_lightning_tpu.reliability import elastic as _elastic
+            # thread-scoped worker seat: concurrent in-process fake
+            # workers never clobber the driver's store or each other
+            prev_store = _elastic.install_worker_client(
+                _elastic.MemoryCheckpointClient(
+                    memstore["channel"], rank=global_rank,
+                    world_size=memstore.get("world_size", num_processes),
+                    candidates=memstore.get("candidates")))
+            store_installed = True
         if heartbeat is not None:
             heartbeat.beat(-1)  # alive: worker entered, before any setup
 
@@ -581,6 +663,10 @@ class RayLauncher:
             _session.shutdown_session()
             if armed_here:
                 _faults.disarm()
+            if store_installed:
+                from ray_lightning_tpu.reliability import \
+                    elastic as _elastic
+                _elastic.install_worker_client(prev_store)
 
         if strategy.global_rank == 0:
             return results
@@ -608,6 +694,12 @@ class RayLauncher:
         while unfinished:
             if queue is not None:
                 self._drain_queue(queue)
+            if self._memstore_channel is not None \
+                    and self._memstore_driver is not None:
+                # replicated in-memory checkpoints ride the same poll as
+                # heartbeats: commits land in the driver store as they
+                # arrive, so a failure any time later still resumes warm
+                self._memstore_driver.drain(self._memstore_channel)
             if monitor is not None:
                 monitor.drain(self._gang_channel)
                 silent = monitor.silent_ranks()
@@ -695,6 +787,17 @@ class RayLauncher:
                 pass  # plain thread queues have no shutdown
             self._gang_channel = None
         self._gang_monitor = None
+        if self._memstore_channel is not None:
+            # final drain BEFORE the channel dies: a commit shipped just
+            # as the gang failed is exactly the one the restart wants
+            if self._memstore_driver is not None:
+                self._memstore_driver.drain(self._memstore_channel)
+            try:
+                self._memstore_channel.shutdown()
+            except AttributeError:
+                pass  # plain thread queues have no shutdown
+            self._memstore_channel = None
+        self._memstore_driver = None
 
 
 class _WorkerSideQueueShim:

@@ -32,12 +32,38 @@ class WorkerOutput(NamedTuple):
     callback_states: Optional[Dict[str, Any]] = None
 
 
-def find_free_port() -> int:
-    """Ask the OS for a free TCP port (coordinator rendezvous bootstrap)."""
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind(("", 0))
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        return s.getsockname()[1]
+def find_free_port(max_attempts: int = 8) -> int:
+    """Ask the OS for a free TCP port (coordinator rendezvous bootstrap),
+    confirming it is genuinely re-bindable before handing it out.
+
+    Restart storms race this probe: between the OS assigning an
+    ephemeral port and the restarted coordinator binding it, a
+    concurrent restart (or any process on a busy host) can grab the
+    port — and a gang restart that trips on the collision burns a whole
+    supervisor attempt on a transient. Each attempt therefore re-binds
+    the probed port on a second socket (without ``SO_REUSEADDR``, the
+    same bind the coordinator will perform) and retries the whole probe
+    on any ``OSError``, bounded by ``max_attempts``. Exhaustion raises
+    ``RuntimeError`` chaining the last bind error.
+    """
+    last: Optional[Exception] = None
+    for _ in range(max(1, max_attempts)):
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+            # confirmation bind, no SO_REUSEADDR: if this fails, the
+            # coordinator's own bind would have failed the same way
+            with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s2:
+                s2.bind(("", port))
+            return port
+        except OSError as exc:
+            last = exc
+    raise RuntimeError(
+        f"no bindable rendezvous port after {max_attempts} probe "
+        f"attempt(s); the host's ephemeral range may be exhausted "
+        f"(restart storm?)") from last
 
 
 def get_node_ip() -> str:

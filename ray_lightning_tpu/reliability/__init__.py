@@ -27,7 +27,13 @@ production stack needs *between* "error raised" and "request failed":
   detection with per-rank postmortems (:class:`GangMonitor` /
   :class:`GangFailure`), and :class:`GangSupervisor`, which restarts the
   full gang on a fresh rendezvous and resumes from the newest committed
-  checkpoint.
+  checkpoint — elastically, at the surviving worker count, when
+  ``elastic=True`` and no warm standby covers the loss.
+- :mod:`~ray_lightning_tpu.reliability.elastic` — the warm recovery
+  tiers: :class:`StandbyPool` (pre-spawned, pre-warmed executor actors
+  promoted into dead rank slots so restarts stop paying actor spawn)
+  and :class:`MemoryCheckpointStore` (last-k train states in host RAM,
+  ring-buddy replicated, consulted ahead of disk by ``resume="auto"``).
 
 See ``docs/reliability.md`` for the full semantics (fault sites, retry
 contract, the replay-exactness argument, and ``resume="auto"``).
@@ -75,6 +81,9 @@ from ray_lightning_tpu.reliability.supervisor import (  # noqa: E402
 from ray_lightning_tpu.reliability.gang import (  # noqa: E402
     GangConfig, GangFailure, GangMonitor, GangSupervisor, HeartbeatEmitter,
     RankPostmortem)
+from ray_lightning_tpu.reliability.elastic import (  # noqa: E402
+    MemoryCheckpointClient, MemoryCheckpointStore, StandbyPool,
+    get_memory_store, install_memory_store, ring_buddy, standby_warmup)
 
 __all__ = [
     "FaultPlan", "FaultSpec", "InjectedFault", "MODE_EXIT", "MODE_NAN",
@@ -86,5 +95,8 @@ __all__ = [
     "FitSupervisor", "ServeSupervisor",
     "GangConfig", "GangFailure", "GangMonitor", "GangSupervisor",
     "HeartbeatEmitter", "RankPostmortem",
+    "MemoryCheckpointClient", "MemoryCheckpointStore", "StandbyPool",
+    "get_memory_store", "install_memory_store", "ring_buddy",
+    "standby_warmup",
     "logger", "log_suppressed",
 ]

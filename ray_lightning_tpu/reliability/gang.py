@@ -56,9 +56,11 @@ EVENT_WORKER_DEAD = "worker.dead"
 EVENT_WORKER_ERROR = "worker.error"
 EVENT_GANG_TEARDOWN = "gang.teardown"
 EVENT_GANG_RESTART = "gang.restart"
+EVENT_GANG_RESIZE = "gang.resize"
 
 GAUGE_ALIVE_WORKERS = "gang_alive_workers"
 COUNTER_RESTARTS = "gang_restarts_total"
+COUNTER_ELASTIC_RESIZES = "gang_elastic_resizes_total"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -325,29 +327,123 @@ class GangSupervisor(FitSupervisor):
     collected on ``self.failures``; each restart emits a
     ``gang.restart`` event and bumps ``gang_restarts_total`` on the
     ``telemetry`` handle (``None`` = disarmed, nothing is allocated).
+
+    **Restart backoff.** Consecutive restarts are spaced by a capped
+    exponential delay (``restart_backoff * 2**(restarts-1)``, capped at
+    ``restart_backoff_cap``) on top of the policy's per-attempt backoff,
+    so a crash-looping gang never hot-spins actor respawns on a busy
+    host. The delay goes through the injectable ``sleep`` — tests stay
+    wall-clock-free — and each applied delay is recorded on
+    ``self.restart_delays``.
+
+    **Elastic world size.** With ``elastic=True`` the supervisor reads
+    each :class:`GangFailure`'s postmortems: ranks flagged ``dead`` or
+    ``silent`` are treated as lost *capacity* (their host is presumed
+    gone — a raised worker error leaves capacity intact and restarts at
+    full size). When the attached ``standby`` pool cannot cover the
+    loss warm, the next attempt restarts at the surviving worker count
+    via ``trainer.strategy.set_world_size(...)`` — the fit then resumes
+    from the newest checkpoint, re-sharded onto the smaller world by
+    the restore path (``docs/reliability.md#elastic-recovery``). The
+    shrink persists across later attempts and never goes below
+    ``min_world_size``; a loss that would means a full-size (respawn-
+    bound, but correct) restart instead. Scale back UP by re-running
+    the supervisor at full size once capacity returns — the same
+    re-shard-on-restore contract covers M→N.
     """
 
     def __init__(self, make_trainer: Callable[[], Any],
                  policy: Optional[RetryPolicy] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 telemetry: Any = None):
+                 telemetry: Any = None,
+                 standby: Optional[Any] = None,
+                 elastic: bool = False,
+                 min_world_size: int = 1,
+                 restart_backoff: float = 0.5,
+                 restart_backoff_cap: float = 30.0):
         super().__init__(make_trainer, policy, sleep)
+        if min_world_size < 1:
+            raise ValueError(
+                f"min_world_size must be >= 1, got {min_world_size}")
+        if restart_backoff < 0 or restart_backoff_cap < 0:
+            raise ValueError("restart backoff values must be >= 0")
         self.telemetry = telemetry
+        self.standby = standby
+        self.elastic = bool(elastic)
+        self.min_world_size = int(min_world_size)
+        self.restart_backoff = float(restart_backoff)
+        self.restart_backoff_cap = float(restart_backoff_cap)
         self.restarts = 0
         self.failures: List[GangFailure] = []
+        self.restart_delays: List[float] = []
+        self.resizes: List[tuple] = []
+        self._target_world: Optional[int] = None
 
     # FitSupervisor hooks -------------------------------------------------
     def _record_failure(self, exc: BaseException) -> None:
         if isinstance(exc, GangFailure):
             self.failures.append(exc)
+            if self.elastic:
+                self._plan_world_size(exc)
+
+    def _plan_world_size(self, failure: GangFailure) -> None:
+        """Decide the next attempt's world size from the postmortems."""
+        world = len(failure.postmortems)
+        lost = [r for r, pm in failure.postmortems.items()
+                if pm.dead or pm.silent]
+        if not lost:
+            return  # error-class failure: capacity intact, full restart
+        if self.standby is not None \
+                and self.standby.live_available() >= len(lost):
+            return  # live warm replacements cover the loss: same world size
+        surviving = world - len(lost)
+        if surviving >= self.min_world_size:
+            self._target_world = surviving
+        else:
+            # below the floor: a full-size restart (respawn-bound, but
+            # correct) beats running a gang too small to be useful
+            self._target_world = None
+            logger.warning(
+                "gang: %d surviving rank(s) < min_world_size=%d; "
+                "restarting at full size instead of shrinking",
+                surviving, self.min_world_size)
+
+    def _prepare_trainer(self, trainer: Any) -> Any:
+        target = self._target_world
+        strategy = getattr(trainer, "strategy", None)
+        if target is None or strategy is None \
+                or strategy.num_workers == target:
+            return trainer
+        prev = strategy.num_workers
+        strategy.set_world_size(target)
+        self.resizes.append((prev, target))
+        logger.warning("gang: elastic restart at world size %d (was %d)",
+                       target, prev)
+        tel = self.telemetry
+        if tel is not None:
+            tel.event(EVENT_GANG_RESIZE, from_world=prev, to_world=target,
+                      min_world_size=self.min_world_size)
+            tel.metrics.counter(
+                COUNTER_ELASTIC_RESIZES,
+                help="gang restarts that resumed at a smaller world "
+                     "size").inc()
+        return trainer
 
     def _on_retry(self, attempt: int) -> None:
         self.restarts += 1
         tel = self.telemetry
         if tel is not None:
             tel.event(EVENT_GANG_RESTART, attempt=attempt,
-                      restarts=self.restarts)
+                      restarts=self.restarts,
+                      standby_available=(self.standby.available()
+                                         if self.standby is not None
+                                         else 0))
             tel.metrics.counter(
                 COUNTER_RESTARTS,
                 help="coordinated gang restarts performed by "
                      "GangSupervisor").inc()
+        if self.restart_backoff:
+            delay = min(self.restart_backoff_cap,
+                        self.restart_backoff * 2.0 ** (self.restarts - 1))
+            self.restart_delays.append(delay)
+            self._sleep(delay)

@@ -249,7 +249,7 @@ class FitSupervisor:
             self.attempts = i
             if i > 1:
                 self._on_retry(i)
-            trainer = self.make_trainer()
+            trainer = self._prepare_trainer(self.make_trainer())
             try:
                 trainer.fit(fresh_module(), datamodule=datamodule,
                             ckpt_path="auto")
@@ -261,6 +261,11 @@ class FitSupervisor:
                                sleep=self._sleep)
 
     # subclass hooks (GangSupervisor) ------------------------------------
+    def _prepare_trainer(self, trainer: Any) -> Any:
+        """Adjust each attempt's freshly built trainer before it fits
+        (GangSupervisor's elastic world-size seat). Default: identity."""
+        return trainer
+
     def _on_retry(self, attempt: int) -> None:
         """Called before each retry attempt (attempt >= 2) starts."""
 

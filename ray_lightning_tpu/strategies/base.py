@@ -42,6 +42,7 @@ class Strategy:
                  use_ray: Optional[bool] = None,
                  allow_colocated_workers: bool = False,
                  gang: Optional[Any] = None,
+                 standby: Optional[Any] = None,
                  **kwargs: Any):
         """Resource-spec semantics mirror ``ray_ddp.py:85-112``:
         ``resources_per_worker`` entries override the dedicated args —
@@ -88,6 +89,9 @@ class Strategy:
         # driver-side hang/death watchdog on Ray-backed launchers this
         # strategy configures. None = the fail-fast-only fault model.
         self.gang = gang
+        # StandbyPool (reliability.elastic): warm pre-spawned workers
+        # the configured launcher promotes into rank slots on restart.
+        self.standby = standby
         self.extra_kwargs = kwargs
 
         self._mesh: Optional[Mesh] = None
@@ -119,7 +123,8 @@ class Strategy:
             return LocalLauncher(self)
         ray = _rl._import_ray()
         if ray is not None and ray.is_initialized():
-            return _rl.RayLauncher(self, ray_module=ray, gang=self.gang)
+            return _rl.RayLauncher(self, ray_module=ray, gang=self.gang,
+                                   standby=self.standby)
         if self.use_ray is True:
             raise RuntimeError(
                 "use_ray=True but no Ray runtime is attached: install ray "
@@ -317,6 +322,30 @@ class Strategy:
                 self.global_to_local[process_idx]
         else:
             self._local_rank, self._node_rank = 0, process_idx
+
+    def set_world_size(self, num_workers: int) -> None:
+        """Adopt a world size chosen at RESTART time — the elastic
+        recovery seat (``GangSupervisor(elastic=True)``).
+
+        The reference fixes the world at construction; elastic resume
+        needs the surviving-capacity count decided *after* a failure.
+        Resizing drops the mesh and the driver-computed rank map (both
+        describe a world that no longer exists — they rebuild lazily on
+        the next launch/fit at the new size); the next restore then
+        re-shards the newest checkpoint onto the resized mesh via the
+        full-host-array restore path. Only strategies whose mesh is
+        derived from ``num_workers`` (the 1-D dp/fsdp families) support
+        this — :class:`MeshStrategy` overrides it to refuse.
+        """
+        n = int(num_workers)
+        if n < 1:
+            raise ValueError(f"world size must be >= 1, got {n}")
+        if n == self.num_workers:
+            return
+        self.num_workers = n
+        self._mesh = None
+        self.global_to_local = None
+        self.set_world_ranks(min(self._global_rank, n - 1))
 
     @property
     def world_size(self) -> int:

@@ -60,6 +60,18 @@ class MeshStrategy(Strategy):
     def mesh_spec(self) -> MeshSpec:
         return MeshSpec(self._axes, dcn_axes=self._dcn_axes)
 
+    def set_world_size(self, num_workers: int) -> None:
+        """Refused: ``num_workers`` here is DERIVED from the axis spec —
+        a bare resize would silently desync mesh and rank model. Elastic
+        multi-axis restarts must rebuild the strategy with resized axes
+        (which axis absorbs the loss is a layout decision, not a
+        count)."""
+        raise RuntimeError(
+            f"MeshStrategy derives num_workers from its axis spec "
+            f"{self._axes}; construct a new MeshStrategy with resized "
+            "axes instead of set_world_size (elastic GangSupervisor "
+            "resize supports the 1-D dp/fsdp strategy families)")
+
     @property
     def world_size(self) -> int:
         sizes = list(self._axes.values())

@@ -1,0 +1,713 @@
+"""Elastic gang recovery: warm standbys, elastic world-size resume, and
+peer-replicated in-memory checkpoints (ISSUE 6 pinned tests).
+
+The load-bearing assertions:
+
+- **elastic resume equivalence**: a checkpoint saved by a 4-way-sharded
+  fit restores 2-way (and a 2-way save restores 4-way) with params AND
+  optimizer state element-identical to the checkpoint, and training
+  continues with correct global-batch accounting;
+- **standby promotion**: a supervised restart fills rank slots from the
+  warm pool (``standby.promoted``) with the postmortem and
+  ``gang.restart`` ordering of PR 5's contract intact;
+- **memory-first resume**: ``resume="auto"`` consults the installed
+  :class:`MemoryCheckpointStore` ahead of disk (newest step wins) and
+  falls back to disk when the ring buddy died too;
+- disarmed = zero surface: no store, no pool ⇒ no channels, no events,
+  no counters.
+"""
+import os
+import shutil
+import socket
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ray_lightning_tpu import (FSDPStrategy, MeshStrategy, ModelCheckpoint,
+                               RayStrategy, Trainer)
+from ray_lightning_tpu.core.callbacks import Callback
+from ray_lightning_tpu.core.checkpoint import (find_resume_candidates,
+                                               is_committed_checkpoint,
+                                               load_sharded_checkpoint,
+                                               prune_checkpoints, step_of)
+from ray_lightning_tpu.launchers import utils as launcher_utils
+from ray_lightning_tpu.launchers.ray_launcher import (ExecutorBase,
+                                                      RayLauncher)
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.obs import Telemetry
+from ray_lightning_tpu.reliability import (FaultPlan, GangConfig,
+                                           GangFailure, GangSupervisor,
+                                           MemoryCheckpointClient,
+                                           MemoryCheckpointStore,
+                                           RankPostmortem, RetryPolicy,
+                                           StandbyPool, get_memory_store,
+                                           ring_buddy)
+from ray_lightning_tpu.reliability.gang import (EVENT_GANG_RESIZE,
+                                                EVENT_GANG_RESTART)
+from ray_lightning_tpu.reliability.elastic import (EVENT_CKPT_RESHARD,
+                                                   EVENT_MEMORY_RESUME,
+                                                   EVENT_STANDBY_PROMOTED)
+from ray_lightning_tpu.testing.fake_ray import FakeRay, ThreadedFakeRay
+
+ELASTIC_SITES = ("worker.dead", "worker.error", "worker.heartbeat_missed",
+                 "gang.teardown", "gang.restart", EVENT_GANG_RESIZE,
+                 EVENT_STANDBY_PROMOTED, EVENT_CKPT_RESHARD,
+                 EVENT_MEMORY_RESUME)
+
+
+def _sites(tel):
+    return [e.site for e in tel.events() if e.site in ELASTIC_SITES]
+
+
+def _snap(tree):
+    return jax.tree_util.tree_map(np.array, jax.device_get(tree))
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(_snap(a))
+    lb = jax.tree_util.tree_leaves(_snap(b))
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------- #
+# ring buddy + memory store semantics
+# --------------------------------------------------------------------- #
+def test_ring_buddy():
+    assert ring_buddy(0, 4) == 1
+    assert ring_buddy(3, 4) == 0
+    assert ring_buddy(0, 1) == 0  # degenerate world: self-buddy
+    with pytest.raises(ValueError):
+        ring_buddy(0, 0)
+
+
+def test_memory_store_keep_last_and_buddy_failover():
+    """Last-k eviction per owner; the replica survives the owner's death
+    (it lives on the ring buddy) and only losing BOTH empties the
+    tier."""
+    store = MemoryCheckpointStore(keep_last=2)
+    for step in (1, 2, 3):
+        store.put(step, {"state": {"a": step}}, rank=0, world_size=4)
+    cands = store.resume_candidates()
+    assert [s for s, _ in cands] == [3, 2]  # keep_last=2, newest first
+    # payloads are isolated copies: mutating a read never corrupts the tier
+    cands[0][1]["state"]["a"] = -1
+    assert store.resume_candidates()[0][1]["state"]["a"] == 3
+    store.drop_rank(0)  # owner's host died: buddy (rank 1) still holds it
+    assert [s for s, _ in store.resume_candidates()] == [3, 2]
+    store.drop_rank(1)  # buddy died too: the memory tier is gone
+    assert store.resume_candidates() == []
+    assert store.latest_step() == -1
+
+
+def test_memory_store_channel_drain():
+    """Worker-side client commits ride the channel and fold into the
+    driver store; foreign messages are ignored."""
+    import queue
+    chan = queue.Queue()
+    client = MemoryCheckpointClient(chan, rank=2, world_size=4)
+    client.put(7, {"state": {"w": 7}})
+    chan.put(("not-a-memckpt", 1, 2))  # stray message: ignored
+    store = MemoryCheckpointStore(keep_last=2)
+    assert store.drain(chan) == 1
+    (step, ckpt), = store.resume_candidates()
+    assert step == 7 and ckpt["state"]["w"] == 7
+    # the commit is replicated: rank 2 AND its ring buddy (rank 3) hold it
+    store.drop_rank(2)
+    assert [s for s, _ in store.resume_candidates()] == [7]
+    # a client put into a dead channel is dropped, never raised
+    class DeadChannel:
+        def put(self, item):
+            raise OSError("closed")
+    MemoryCheckpointClient(DeadChannel(), rank=0).put(1, {"state": {}})
+
+
+def test_memory_store_install_is_scoped():
+    assert get_memory_store() is None
+    store = MemoryCheckpointStore()
+    with store.installed():
+        assert get_memory_store() is store
+        inner = MemoryCheckpointStore()
+        with inner.installed():
+            assert get_memory_store() is inner
+        assert get_memory_store() is store
+    assert get_memory_store() is None
+
+
+# --------------------------------------------------------------------- #
+# standby pool
+# --------------------------------------------------------------------- #
+def test_standby_pool_fill_take_refill_shutdown():
+    fake = FakeRay()
+    pool = StandbyPool(fake, num_standby=2, warmup=None)
+    make = lambda: fake.remote(ExecutorBase).options().remote()  # noqa: E731
+    assert pool.fill(make) == 2
+    assert pool.available() == 2
+    assert pool.fill(make) == 0  # idempotent at capacity
+    first = pool.take()
+    assert first is not None and pool.available() == 1
+    assert pool.promotions == 1
+    # a dead standby is dropped, the next live one is promoted
+    with pool._lock:
+        dead_actor = pool._idle[0][0]
+    fake.kill(dead_actor)
+    pool.fill(make)  # top back up to 2 (one dead + one live)
+    got = pool.take()
+    assert got is not None and not got._killed
+    # refill_async tops the pool back up off-thread
+    pool.refill_async(make)
+    deadline = time.monotonic() + 5
+    while pool.available() < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert pool.available() == 2
+    pool.shutdown()
+    assert pool.available() == 0
+    assert pool.take() is None
+    # every actor still alive is one the pool PROMOTED (now caller-owned);
+    # every idle standby was killed — nothing leaked from the pool
+    alive = {id(a) for a in fake.created_actors if not a._killed}
+    assert alive == {id(first), id(got)}
+
+
+def test_standby_pool_warmup_runs_in_actor():
+    fake = FakeRay()
+    ran = []
+    pool = StandbyPool(fake, num_standby=1, warmup=lambda: ran.append(1))
+    pool.fill(lambda: fake.remote(ExecutorBase).options().remote())
+    actor = pool.take()  # take() resolves the warmup future
+    assert actor is not None and ran == [1]
+    pool.shutdown()
+    fake.kill(actor)
+
+
+# --------------------------------------------------------------------- #
+# rendezvous port probing + retention satellites
+# --------------------------------------------------------------------- #
+def test_find_free_port_retries_on_bind_collision(monkeypatch):
+    """The probe retries transient bind collisions (restart storms) with
+    bounded attempts instead of failing the restart."""
+    real_socket = socket.socket
+    fails = {"n": 2}
+
+    class FlakySocket:
+        def __init__(self, *a, **kw):
+            self._s = real_socket(*a, **kw)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self._s.close()
+
+        def bind(self, addr):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(98, "Address already in use")
+            return self._s.bind(addr)
+
+        def __getattr__(self, name):
+            return getattr(self._s, name)
+
+    monkeypatch.setattr(socket, "socket", FlakySocket)
+    port = launcher_utils.find_free_port(max_attempts=8)
+    assert 0 < port < 65536 and fails["n"] == 0
+    # bounded: exhaustion raises instead of looping forever
+    fails["n"] = 10 ** 9
+    with pytest.raises(RuntimeError, match="no bindable rendezvous port"):
+        launcher_utils.find_free_port(max_attempts=3)
+
+
+def _make_committed_ckpt(root, name):
+    path = os.path.join(root, name)
+    os.makedirs(path)
+    with open(os.path.join(path, "tl_meta.msgpack"), "wb") as f:
+        f.write(b"\x80")  # empty msgpack map: a valid commit marker
+    return path
+
+
+def test_prune_checkpoints_marker_aware(tmp_path):
+    root = str(tmp_path)
+    old = _make_committed_ckpt(root, "epoch=0-step=2")
+    mid = _make_committed_ckpt(root, "epoch=1-step=4")
+    new = _make_committed_ckpt(root, "epoch=2-step=6")
+    # a marker-less dir (possibly an in-flight async commit) and a tmp
+    # staging dir must NEVER be pruned
+    inflight = os.path.join(root, "epoch=3-step=8")
+    os.makedirs(inflight)
+    staging = os.path.join(root, "epoch=0-step=2.tmp-123")
+    os.makedirs(staging)
+    doomed = prune_checkpoints(root, keep_last_n=1, protect=[mid])
+    assert doomed == [old]
+    assert not os.path.exists(old)
+    assert os.path.exists(new)       # newest committed always survives
+    assert os.path.exists(mid)       # protected (e.g. top-k ledger)
+    assert os.path.exists(inflight)  # marker-less: untouchable
+    assert os.path.exists(staging)   # tmp staging: not even a candidate
+    with pytest.raises(ValueError):
+        prune_checkpoints(root, keep_last_n=0)
+    assert not is_committed_checkpoint(inflight)
+    assert is_committed_checkpoint(new)
+
+
+def test_find_resume_candidates_keep_last_n(tmp_path):
+    root = str(tmp_path)
+    for step in (2, 4, 6, 8):
+        _make_committed_ckpt(root, f"epoch=0-step={step}")
+    out = find_resume_candidates(root, keep_last_n=2)
+    assert [step_of(p) for p in out] == [8, 6]
+    assert sorted(step_of(p) for p in find_resume_candidates(root)) \
+        == [6, 8]  # the older two are really gone from disk
+
+
+def test_model_checkpoint_keep_last_n_retention(tmp_path):
+    """The chaos-run leak: each restart's fresh ModelCheckpoint knows
+    nothing about PRIOR attempts' files, so its own top-k pruning never
+    touches them and long supervised runs accumulate checkpoints without
+    bound. keep_last_n prunes that litter while protecting everything
+    the live ledger still tracks — and resume still works."""
+    ck = str(tmp_path / "ck")
+    litter = [_make_committed_ckpt(ck, f"epoch=0-step={s}-old")
+              for s in (1, 3)]  # a prior crashed attempt's saves
+    trainer = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=3,
+                      seed=0, limit_train_batches=4, limit_val_batches=0,
+                      callbacks=[ModelCheckpoint(dirpath=ck,
+                                                 every_n_train_steps=2,
+                                                 keep_last_n=1)],
+                      default_root_dir=str(tmp_path))
+    trainer.fit(BoringModel())
+    assert not any(os.path.exists(p) for p in litter)
+    remaining = find_resume_candidates(ck)
+    assert remaining and step_of(remaining[0]) == 12  # newest survived
+    trainer2 = Trainer(strategy=RayStrategy(num_workers=1), max_epochs=3,
+                       seed=0, limit_train_batches=4, limit_val_batches=0,
+                       callbacks=[ModelCheckpoint(dirpath=ck)],
+                       default_root_dir=str(tmp_path))
+    trainer2.fit(BoringModel(), ckpt_path="auto")
+    _leaves_equal(trainer2.train_state.params, trainer.train_state.params)
+    with pytest.raises(ValueError, match="keep_last_n"):
+        ModelCheckpoint(keep_last_n=0)
+
+
+# --------------------------------------------------------------------- #
+# elastic world-size resume (save N-way, restore M-way) — PINNED
+# --------------------------------------------------------------------- #
+def _fit_fsdp(tmp_path, world, max_epochs, ck, tel=None, resume=None):
+    trainer = Trainer(strategy=FSDPStrategy(num_workers=world,
+                                            use_tpu=False),
+                      max_epochs=max_epochs, seed=0, limit_train_batches=3,
+                      limit_val_batches=0,
+                      callbacks=[ModelCheckpoint(dirpath=ck,
+                                                 save_format="orbax")],
+                      default_root_dir=str(tmp_path), telemetry=tel)
+    trainer.fit(BoringModel(), ckpt_path=resume)
+    return trainer
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_elastic_resume_4_to_2_element_identical(tmp_path):
+    """PINNED: a 4-way-sharded checkpoint (params + optimizer state
+    sharded over fsdp=4) restores onto a 2-way mesh element-identical,
+    emits ckpt.reshard, and training continues with correct global-batch
+    accounting (global_step picks up where the save left off)."""
+    ck = str(tmp_path / "ck")
+    _fit_fsdp(tmp_path, 4, 2, ck)
+    path = find_resume_candidates(ck)[0]
+    host = load_sharded_checkpoint(path)
+    assert host["world"]["world_size"] == 4
+    assert host["global_step"] == 6
+
+    # element identity of the RESTORE itself (params AND optimizer
+    # state): restore the checkpoint with no epochs left to train, so
+    # the final state IS the re-sharded restore
+    tel = Telemetry()
+    t2b = _fit_fsdp(tmp_path, 2, 2, ck, tel=tel, resume="auto")
+    assert t2b.global_step == 6
+    leaf = jax.tree_util.tree_leaves(t2b.train_state.params)[0]
+    assert leaf.sharding.mesh.shape["fsdp"] == 2
+    _leaves_equal(t2b.train_state.params, host["state"]["params"])
+    _leaves_equal(t2b.train_state.opt_state, host["state"]["opt_state"])
+    reshard = [e for e in tel.events() if e.site == EVENT_CKPT_RESHARD]
+    assert len(reshard) == 1
+    assert reshard[0].payload["from_world"] == 4
+    assert reshard[0].payload["to_world"] == 2
+    assert tel.metrics.snapshot()["ckpt_reshards_total"] == 1
+
+    # global-batch accounting: one more epoch of 3 global batches runs
+    # at the new size, picking up exactly where the save left off
+    t2 = _fit_fsdp(tmp_path, 2, 3, ck, resume="auto")
+    assert t2.global_step == 9 and t2.current_epoch == 2
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_elastic_resume_2_to_4_scale_up(tmp_path):
+    """The same contract in the scale-up direction (capacity returned)."""
+    ck = str(tmp_path / "ck")
+    _fit_fsdp(tmp_path, 2, 2, ck)
+    host = load_sharded_checkpoint(find_resume_candidates(ck)[0])
+    assert host["world"]["world_size"] == 2
+    t4 = _fit_fsdp(tmp_path, 4, 2, ck, resume="auto")
+    assert t4.global_step == 6  # nothing left to train: pure restore
+    leaf = jax.tree_util.tree_leaves(t4.train_state.params)[0]
+    assert leaf.sharding.mesh.shape["fsdp"] == 4
+    _leaves_equal(t4.train_state.params, host["state"]["params"])
+    _leaves_equal(t4.train_state.opt_state, host["state"]["opt_state"])
+
+
+def test_strategy_set_world_size_resets_world():
+    s = RayStrategy(num_workers=4, use_tpu=False)
+    mesh1 = s.mesh
+    assert mesh1.shape["dp"] == 4
+    s.set_world_size(2)
+    assert s.num_workers == 2 and s.world_size == 2
+    assert s.mesh.shape["dp"] == 2  # mesh rebuilt at the new size
+    assert s.distributed_sampler_kwargs["num_replicas"] == 2
+    with pytest.raises(ValueError):
+        s.set_world_size(0)
+
+
+def test_mesh_strategy_refuses_elastic_resize():
+    s = MeshStrategy(axes={"dp": 2, "tp": 2}, use_tpu=False)
+    with pytest.raises(RuntimeError, match="resized axes"):
+        s.set_world_size(2)
+
+
+# --------------------------------------------------------------------- #
+# GangSupervisor elastic policy + restart backoff
+# --------------------------------------------------------------------- #
+def _gang_failure(world, lost, dead=True):
+    pms = {
+        r: RankPostmortem(rank=r, last_step=5, last_beat_age_s=1.0,
+                          beats=5, node_ip=None,
+                          dead=dead and r in lost,
+                          silent=(not dead) and r in lost)
+        for r in range(world)
+    }
+    return GangFailure("worker.dead" if dead else "worker.heartbeat_missed",
+                       pms)
+
+
+class _StubStrategy:
+    def __init__(self, n):
+        self.num_workers = n
+        self.resized = []
+
+    def set_world_size(self, n):
+        self.resized.append(n)
+        self.num_workers = n
+
+
+class _StubTrainer:
+    def __init__(self, n, failures):
+        self.strategy = _StubStrategy(n)
+        self._failures = failures
+        self.state = "idle"
+
+    def fit(self, module, datamodule=None, ckpt_path=None):
+        if self._failures:
+            raise self._failures.pop(0)
+        self.state = "finished"
+
+
+def test_gang_supervisor_elastic_policy(tmp_path):
+    """4-way gang loses 2 ranks, no standby: the restart shrinks to the
+    surviving count (events + counters pinned); losses below
+    min_world_size fall back to a full-size restart."""
+    tel = Telemetry()
+    failures = [_gang_failure(4, lost=[2, 3])]
+    trainers = []
+
+    def make_trainer():
+        t = _StubTrainer(4, failures)
+        trainers.append(t)
+        return t
+
+    sup = GangSupervisor(make_trainer, RetryPolicy(max_attempts=3,
+                                                   base_delay=0.0),
+                         sleep=lambda s: None, telemetry=tel,
+                         elastic=True, min_world_size=2)
+    trainer = sup.fit(object)
+    assert trainer.state == "finished"
+    assert sup.resizes == [(4, 2)]
+    assert trainers[1].strategy.num_workers == 2
+    resize = [e for e in tel.events() if e.site == EVENT_GANG_RESIZE]
+    assert len(resize) == 1
+    assert resize[0].payload == {"from_world": 4, "to_world": 2,
+                                 "min_world_size": 2}
+    assert tel.metrics.snapshot()["gang_elastic_resizes_total"] == 1
+    # pinned ordering: the restart precedes (and decides) the resize
+    order = [e.site for e in tel.events()
+             if e.site in (EVENT_GANG_RESTART, EVENT_GANG_RESIZE)]
+    assert order == [EVENT_GANG_RESTART, EVENT_GANG_RESIZE]
+
+    # below the floor: full-size restart instead of a too-small gang
+    failures2 = [_gang_failure(4, lost=[1, 2, 3], dead=False)]
+    sup2 = GangSupervisor(lambda: _StubTrainer(4, failures2),
+                          RetryPolicy(max_attempts=3, base_delay=0.0),
+                          sleep=lambda s: None, elastic=True,
+                          min_world_size=2)
+    t2 = sup2.fit(object)
+    assert t2.state == "finished" and sup2.resizes == []
+
+    # an error-class failure (no dead/silent rank) keeps full capacity
+    failures3 = [GangFailure("worker.error", {
+        r: RankPostmortem(r, 5, 1.0, 5, None) for r in range(4)})]
+    sup3 = GangSupervisor(lambda: _StubTrainer(4, failures3),
+                          RetryPolicy(max_attempts=3, base_delay=0.0),
+                          sleep=lambda s: None, elastic=True)
+    t3 = sup3.fit(object)
+    assert t3.state == "finished" and sup3.resizes == []
+
+
+def test_gang_supervisor_standby_covers_loss():
+    """With enough warm standbys the world size is NOT shrunk — the
+    promotion path keeps full capacity."""
+    fake = FakeRay()
+    pool = StandbyPool(fake, num_standby=2, warmup=None)
+    pool.fill(lambda: fake.remote(ExecutorBase).options().remote())
+    failures = [_gang_failure(4, lost=[3])]
+    sup = GangSupervisor(lambda: _StubTrainer(4, failures),
+                         RetryPolicy(max_attempts=3, base_delay=0.0),
+                         sleep=lambda s: None, elastic=True, standby=pool)
+    trainer = sup.fit(object)
+    assert trainer.state == "finished"
+    assert sup.resizes == [] and trainer.strategy.num_workers == 4
+    pool.shutdown()
+
+
+def test_gang_supervisor_restart_backoff_capped():
+    """Consecutive restarts back off exponentially (capped) through the
+    injectable sleep — a crash-looping gang never hot-spins respawns."""
+    slept = []
+    failures = [_gang_failure(2, lost=[1]) for _ in range(3)]
+    sup = GangSupervisor(lambda: _StubTrainer(2, failures),
+                         RetryPolicy(max_attempts=4, base_delay=0.0),
+                         sleep=slept.append, restart_backoff=1.0,
+                         restart_backoff_cap=3.0)
+    trainer = sup.fit(object)
+    assert trainer.state == "finished"
+    # policy delays are 0.0; the restart backoff ladder is 1, 2, capped 3
+    assert sup.restart_delays == [1.0, 2.0, 3.0]
+    assert [d for d in slept if d > 0] == [1.0, 2.0, 3.0]
+    with pytest.raises(ValueError):
+        GangSupervisor(lambda: None, min_world_size=0)
+
+
+class _FailGangOnce(Callback):
+    """Raises a synthetic GangFailure at the end of one epoch, once —
+    the failure-injection seat for the end-to-end elastic test (a real
+    multi-process CPU gang cannot form under jaxlib's CPU backend, the
+    suite-wide xfail class)."""
+
+    def __init__(self, shared, at_epoch, world, lost):
+        self._shared = shared
+        self._at = at_epoch
+        self._world = world
+        self._lost = lost
+
+    def on_train_epoch_end(self, trainer, pl_module):
+        if not self._shared["fired"] and trainer.current_epoch == self._at:
+            self._shared["fired"] = True
+            raise _gang_failure(self._world, lost=self._lost)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 devices")
+def test_gang_supervisor_elastic_end_to_end(tmp_path):
+    """Supervised 4-way fit loses half its capacity after epoch 1: the
+    retry resumes at world size 2 from the epoch-1 checkpoint, re-shards
+    on restore, and finishes with correct step accounting."""
+    ck = str(tmp_path / "ck")
+    tel = Telemetry()
+    shared = {"fired": False}
+
+    def make_trainer():
+        return Trainer(strategy=FSDPStrategy(num_workers=4, use_tpu=False),
+                       max_epochs=3, seed=0, limit_train_batches=3,
+                       limit_val_batches=0,
+                       callbacks=[ModelCheckpoint(dirpath=ck,
+                                                  save_format="orbax"),
+                                  _FailGangOnce(shared, at_epoch=1,
+                                                world=4, lost=[2, 3])],
+                       default_root_dir=str(tmp_path), telemetry=tel)
+
+    sup = GangSupervisor(make_trainer,
+                         RetryPolicy(max_attempts=3, base_delay=0.0),
+                         sleep=lambda s: None, telemetry=tel,
+                         elastic=True, min_world_size=2)
+    trainer = sup.fit(BoringModel)
+    assert trainer.state == "finished"
+    assert sup.attempts == 2 and sup.resizes == [(4, 2)]
+    assert trainer.strategy.num_workers == 2
+    assert trainer.global_step == 9 and trainer.current_epoch == 2
+    leaf = jax.tree_util.tree_leaves(trainer.train_state.params)[0]
+    assert leaf.sharding.mesh.shape["fsdp"] == 2
+    order = [e.site for e in tel.events()
+             if e.site in (EVENT_GANG_RESTART, EVENT_GANG_RESIZE,
+                           EVENT_CKPT_RESHARD)]
+    assert order == [EVENT_GANG_RESTART, EVENT_GANG_RESIZE,
+                     EVENT_CKPT_RESHARD]
+
+
+# --------------------------------------------------------------------- #
+# memory-first resume="auto"
+# --------------------------------------------------------------------- #
+def _local_trainer(tmp_path, ck, tel=None, max_epochs=3):
+    return Trainer(strategy=RayStrategy(num_workers=1), max_epochs=max_epochs,
+                   seed=0, limit_train_batches=4, limit_val_batches=0,
+                   callbacks=[ModelCheckpoint(dirpath=ck)],
+                   default_root_dir=str(tmp_path), telemetry=tel)
+
+
+def test_memory_resume_ahead_of_disk_and_buddy_fallback(tmp_path):
+    """A killed fit resumes from the in-memory tier (ckpt.memory_resume
+    pinned; final params bitwise-identical to the uninterrupted run);
+    with the store's entries gone (owner AND buddy died) the same
+    resume falls back to disk and still matches bitwise."""
+    ref = _local_trainer(tmp_path / "ref", str(tmp_path / "ref_ck"))
+    ref.fit(BoringModel())
+    ref_params = _snap(ref.train_state.params)
+
+    ck = str(tmp_path / "ck")
+    tel = Telemetry()
+    store = MemoryCheckpointStore(keep_last=2)
+    with store.installed():
+        with pytest.raises(Exception):
+            with FaultPlan.at("train.step", [9]).armed():
+                _local_trainer(tmp_path, ck, tel=tel).fit(BoringModel())
+        assert store.puts >= 2  # epoch-0 and epoch-1 commits mirrored
+        assert store.latest_step() == 8
+        trainer = _local_trainer(tmp_path, ck, tel=tel)
+        trainer.fit(BoringModel(), ckpt_path="auto")
+    mem_events = [e for e in tel.events() if e.site == EVENT_MEMORY_RESUME]
+    assert len(mem_events) == 1 and mem_events[0].payload["step"] == 8
+    _leaves_equal(trainer.train_state.params, ref_params)
+
+    # buddy death: world_size=1 self-buddies on rank 0, so dropping rank
+    # 0 loses both copies — resume must fall back to disk, bitwise-equal
+    store.drop_rank(0)
+    tel2 = Telemetry()
+    with store.installed():
+        trainer2 = _local_trainer(tmp_path / "run2", ck, tel=tel2)
+        trainer2.fit(BoringModel(), ckpt_path="auto")
+    assert [e for e in tel2.events()
+            if e.site == EVENT_MEMORY_RESUME] == []
+    _leaves_equal(trainer2.train_state.params, ref_params)
+
+
+def test_memory_resume_prefers_newer_disk(tmp_path):
+    """A stale memory tier (older step than disk) must NOT win: resuming
+    from it would silently lose committed progress."""
+    ck = str(tmp_path / "ck")
+    trainer = _local_trainer(tmp_path, ck, max_epochs=2)
+    trainer.fit(BoringModel())  # disk now holds step=8
+    tel = Telemetry()
+    store = MemoryCheckpointStore()
+    store.put(4, {"state": {"bogus": 1}, "global_step": 4})
+    with store.installed():
+        t2 = _local_trainer(tmp_path, ck, tel=tel, max_epochs=2)
+        t2.fit(BoringModel(), ckpt_path="auto")
+    assert [e for e in tel.events() if e.site == EVENT_MEMORY_RESUME] == []
+    _leaves_equal(t2.train_state.params, trainer.train_state.params)
+
+
+def test_memory_replication_through_fake_gang(tmp_path):
+    """RayLauncher plumbing end-to-end on the threaded fake: worker
+    commits ride the replication channel into the driver store, and a
+    later launch resumes from the SHIPPED candidates alone (disk
+    deleted)."""
+    fake = ThreadedFakeRay()
+    store = MemoryCheckpointStore(keep_last=2)
+    ck = str(tmp_path / "ck")
+
+    def make_trainer():
+        trainer = _local_trainer(tmp_path, ck)
+        trainer._launcher = RayLauncher(
+            trainer.strategy, ray_module=fake,
+            gang=GangConfig(heartbeat_timeout=30.0))
+        return trainer
+
+    with store.installed():
+        trainer = make_trainer()
+        trainer.fit(BoringModel())
+        assert store.puts == 3  # one commit per epoch crossed the channel
+        assert store.latest_step() == 12
+        final = _snap(trainer.train_state_dict["params"])
+        shutil.rmtree(ck)  # memory is now the ONLY copy
+        trainer2 = make_trainer()
+        trainer2.fit(BoringModel(), ckpt_path="auto")
+    _leaves_equal(trainer2.train_state_dict["params"], final)
+    # the launcher tore its channels down
+    assert trainer2._launcher._memstore_channel is None
+    assert trainer2._launcher._memstore_driver is None
+
+
+# --------------------------------------------------------------------- #
+# standby promotion through the supervised restart (threaded fake)
+# --------------------------------------------------------------------- #
+def test_standby_promotion_event_order_fake_gang(tmp_path):
+    """PR 5's detection contract is intact with a pool attached, and the
+    restarted gang's rank slot is filled by promotion:
+    worker.error -> gang.teardown -> gang.restart -> standby.promoted."""
+    fake = ThreadedFakeRay()
+    tel = Telemetry()
+    pool = StandbyPool(fake, num_standby=2, warmup=None, telemetry=tel)
+    pool.fill(lambda: fake.remote(ExecutorBase).options().remote())
+    ck = str(tmp_path / "ck")
+
+    def make_trainer():
+        strategy = RayStrategy(num_workers=1)
+        trainer = Trainer(strategy=strategy, max_epochs=3, seed=0,
+                          limit_train_batches=4, limit_val_batches=0,
+                          callbacks=[ModelCheckpoint(dirpath=ck)],
+                          default_root_dir=str(tmp_path), telemetry=tel)
+        trainer._launcher = RayLauncher(
+            strategy, ray_module=fake,
+            gang=GangConfig(heartbeat_timeout=30.0), standby=pool)
+        return trainer
+
+    sup = GangSupervisor(make_trainer,
+                         RetryPolicy(max_attempts=3, base_delay=0.0),
+                         sleep=lambda s: None, telemetry=tel, standby=pool)
+    with FaultPlan.at("train.step", [9]).armed():
+        trainer = sup.fit(BoringModel)
+    pool.shutdown()
+    assert trainer.state == "finished"
+    assert sup.restarts == 1
+    # attempt 1 promoted a prefilled standby; the RESTART promoted the
+    # second (num_standby=2 makes this deterministic — no refill race)
+    assert pool.promotions == 2
+    assert sup.failures[0].reason == "worker.error"
+    assert sup.failures[0].postmortems[0].last_step == 9
+    assert _sites(tel) == [EVENT_STANDBY_PROMOTED, "worker.error",
+                           "gang.teardown", EVENT_GANG_RESTART,
+                           EVENT_STANDBY_PROMOTED]
+    assert tel.metrics.snapshot()["gang_standby_promotions_total"] == 2
+
+
+# --------------------------------------------------------------------- #
+# disarmed = zero surface
+# --------------------------------------------------------------------- #
+def test_elastic_disarmed_zero_surface(tmp_path):
+    """No pool, no store: no channels allocated, no elastic events, no
+    elastic counters — PR 5's cost profile is untouched."""
+    fake = FakeRay()
+    tel = Telemetry()
+    strategy = RayStrategy(num_workers=1)
+    trainer = Trainer(strategy=strategy, max_epochs=1, seed=0,
+                      limit_train_batches=2, limit_val_batches=0,
+                      callbacks=[ModelCheckpoint(
+                          dirpath=str(tmp_path / "ck"))],
+                      default_root_dir=str(tmp_path), telemetry=tel)
+    launcher = RayLauncher(strategy, ray_module=fake)
+    trainer._launcher = launcher
+    trainer.fit(BoringModel())
+    assert launcher._memstore_channel is None
+    assert launcher._memstore_driver is None
+    assert launcher._standby is None
+    assert _sites(tel) == []
+    snap = tel.metrics.snapshot()
+    for name in ("gang_standby_promotions_total",
+                 "gang_elastic_resizes_total", "ckpt_reshards_total"):
+        assert name not in snap

@@ -460,3 +460,62 @@ def test_gang_worker_stall_detected_within_timeout_and_restarted(
     _params_equal(trainer.train_state_dict["params"], process_ref_params)
     assert _gang_sites(tel) == ["worker.heartbeat_missed", "gang.teardown",
                                 "gang.restart"]
+
+
+@pytest.mark.multiproc
+def test_gang_standby_promotion_process_backend(tmp_path,
+                                                process_ref_params):
+    """PINNED (ISSUE 6): a worker hard-killed mid-epoch-2 restarts by
+    PROMOTING a pre-warmed standby — no actor spawn on the recovery
+    critical path — with PR 5's postmortem and event-order contract
+    intact (worker.dead -> gang.teardown -> gang.restart, the promotion
+    following the restart), bitwise-identical final params, and ZERO
+    live actor processes after fit teardown + pool shutdown (the
+    no-leak contract every channel/store/pool teardown path owes)."""
+    from ray_lightning_tpu.launchers.ray_launcher import ExecutorBase
+    from ray_lightning_tpu.reliability import StandbyPool
+    ray_mod = ProcessRay(worker_env=dict(WORKER_ENV))
+    ray_mod.init()
+    tel = Telemetry()
+    # num_standby=2 + a synchronous prefill makes the restart's warm
+    # promotion deterministic: attempt 1 takes one (the take-first spawn
+    # cache), the restart takes the other — no background-refill race
+    pool = StandbyPool(ray_mod, num_standby=2, telemetry=tel)
+    pool.fill(lambda: ray_mod.remote(ExecutorBase).options().remote())
+    gang = GangConfig(heartbeat_timeout=120.0)
+
+    def make_trainer():
+        strategy = RayStrategy(num_workers=1)
+        trainer = Trainer(strategy=strategy, max_epochs=3, seed=0,
+                          limit_train_batches=4, limit_val_batches=0,
+                          callbacks=[ModelCheckpoint(
+                              dirpath=str(tmp_path / "ck"))],
+                          default_root_dir=str(tmp_path), telemetry=tel)
+        trainer._launcher = RayLauncher(strategy, ray_module=ray_mod,
+                                        gang=gang, standby=pool)
+        return trainer
+
+    sup = GangSupervisor(make_trainer,
+                         RetryPolicy(max_attempts=3, base_delay=0.0),
+                         sleep=lambda s: None, telemetry=tel, standby=pool)
+    try:
+        with FaultPlan.at("worker.exit", [9], mode="exit").armed():
+            trainer = sup.fit(BoringModel)
+        pool.shutdown()
+        # the no-leak pin: gang teardown killed every worker (promoted
+        # ones included) and pool shutdown killed every idle standby
+        assert ray_mod.live_actor_count() == 0
+    finally:
+        ray_mod.shutdown()
+    assert sup.attempts == 2 and sup.restarts == 1
+    assert trainer.state == "finished"
+    assert pool.promotions == 2  # attempt 1 AND the restart, both warm
+    failure = sup.failures[0]
+    assert failure.reason == "worker.dead"
+    assert failure.postmortems[0].dead
+    assert failure.postmortems[0].last_step == 9
+    _params_equal(trainer.train_state_dict["params"], process_ref_params)
+    sites = [e.site for e in tel.events()
+             if e.site in GANG_SITES + ("standby.promoted",)]
+    assert sites == ["standby.promoted", "worker.dead", "gang.teardown",
+                     "gang.restart", "standby.promoted"]

@@ -1,0 +1,110 @@
+"""AST lint: every acquired channel/store/pool/monitor has a teardown path.
+
+Sibling of ``test_lint_sleep.py`` / ``test_lint_unreachable.py``. The
+elastic-recovery layer multiplied the number of driver-owned resource
+objects (heartbeat channels, memory-checkpoint replication channels,
+standby pools, gang monitors) — and a channel or pool without a
+registered teardown is how actors and manager queues leak across
+supervised restarts (the runtime side of this contract is pinned by the
+process-backend tests asserting ``live_actor_count() == 0`` after fit
+teardown + pool shutdown).
+
+The rule: any ``self.X = <resource factory call>`` inside a class —
+where the factory's terminal name is one of :data:`RESOURCE_FACTORIES`
+(queue channels, sync managers, gang monitors, standby pools, memory
+stores) — requires the SAME file to also release that attribute:
+``self.X = None``, or a ``self.X.shutdown()`` / ``self.X.close()``
+call, or an explicit ``tl-lint: allow-leak — <why>`` marker on the
+acquisition line. Conditional-expression assignments and locals are out
+of scope (the lint is a tripwire for the common spelling, not a full
+escape analysis).
+"""
+import ast
+import pathlib
+
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "ray_lightning_tpu"
+
+MARKER = "tl-lint: allow-leak"
+
+#: terminal callee names whose result owns OS/process-backed resources
+RESOURCE_FACTORIES = {
+    "_make_queue_channel", "make_queue", "Queue", "Manager",
+    "GangMonitor", "StandbyPool", "MemoryCheckpointStore",
+}
+
+RELEASE_METHODS = {"shutdown", "close", "_kill", "kill"}
+
+
+def _terminal_name(func):
+    """`a.b.C(...)` -> "C"; `C(...)` -> "C"; anything else -> None."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_self_attr(node):
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+def _acquisitions(cls):
+    """(attr, lineno) for every ``self.X = <resource factory>()``."""
+    out = []
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        if _terminal_name(node.value.func) not in RESOURCE_FACTORIES:
+            continue
+        for target in node.targets:
+            if _is_self_attr(target):
+                out.append((target.attr, node.lineno))
+    return out
+
+
+def _releases(cls):
+    """Attr names released somewhere in the class: ``self.X = None`` or
+    ``self.X.<shutdown|close|kill>()``."""
+    released = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                node.value.value is None:
+            for target in node.targets:
+                if _is_self_attr(target):
+                    released.add(target.attr)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in RELEASE_METHODS and \
+                _is_self_attr(node.func.value):
+            released.add(node.func.value.attr)
+    return released
+
+
+@pytest.mark.parametrize(
+    "path", sorted(PKG.rglob("*.py")), ids=lambda p: str(p.relative_to(PKG)))
+def test_every_acquired_resource_has_a_teardown_path(path):
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        released = _releases(node)
+        for attr, lineno in _acquisitions(node):
+            if attr in released or MARKER in lines[lineno - 1]:
+                continue
+            offenders.append(
+                f"{path.relative_to(PKG.parent)}:{lineno} "
+                f"(self.{attr} in class {node.name})")
+    assert not offenders, (
+        "resource acquired without a registered teardown path — release "
+        "it in the owning class (`self.X = None` after shutdown, or call "
+        "`self.X.shutdown()`/`.close()`), or mark the acquisition with "
+        f"`# {MARKER} — <why>`: " + ", ".join(offenders))
