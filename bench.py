@@ -1001,6 +1001,144 @@ def _bench_serve(num_slots: int = 8, n_requests: int = 16,
     }
 
 
+def _bench_paged(num_slots: int = 8, prompt: int = 64,
+                 new_tokens: int = 64, page_size: int = 16,
+                 prefill_chunk: int = 64, long_prompt: int = 384,
+                 n_prefix: int = 8) -> dict:
+    """Paged-KV serving additions to ``extras["serve"]`` (ROADMAP item 1).
+
+    Three measurements, one per lever:
+
+    - ``paged_concurrent_capacity``: co-resident admissions at the SAME
+      KV byte budget as the static slot pool, on the pinned mixed-length
+      request set (same rng as ``_bench_serve``'s trace). Pure allocator
+      accounting — :class:`PagePool` builds its arena lazily, so this
+      measures the admission math the real engine runs, without device
+      memory. A short request holds ``ceil((prompt+budget)/page_size)``
+      pages instead of a ``max_seq_len`` row; >= 2x expected at this mix.
+    - ``prefix_cache_hit_rate``: fraction of adoptable prompt-prefix
+      pages actually served from cache on a shared-system-prompt trace
+      (``n_prefix`` requests, one ``prompt``-token system prefix plus
+      distinct tails) through the REAL chunked+prefix engine.
+    - ``decode_stall_p99_ms``: the Sarathi bound. Three short requests
+      decode while a ``long_prompt``-token prompt arrives; the stall is
+      the wall gap between consecutive decode dispatches around the
+      injection. Monolithic prefill pays the whole prompt in one gap;
+      chunked prefill alternates chunk/decode dispatches, bounding the
+      p99 gap near ONE chunk's compute. Both sides run the paged engine
+      (same gather/scatter tax), isolating the scheduling policy.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.obs.metrics import Histogram
+    from ray_lightning_tpu.serve import PagePool, Request, ServeEngine
+    from ray_lightning_tpu.serve.engine import SlotPoolFull
+
+    max_len = long_prompt + prefill_chunk * 2
+    base = dict(vocab_size=50304, max_seq_len=max_len, dtype=jnp.bfloat16,
+                scan_layers=False)
+    model = TransformerLM(gpt2_config("small", **base))
+    toks0 = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50257, size=(2, 8)), jnp.int32)
+    params = jax.device_put(jax.jit(
+        lambda r: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16),
+            model.init(r, toks0)["params"]))(jax.random.PRNGKey(0)))
+    dec = TransformerLM(gpt2_config("small", decode=True,
+                                    param_dtype=jnp.bfloat16, **base))
+
+    # ---- capacity: same arena bytes as num_slots static rows ----------
+    pages_per_row = max_len // page_size
+    pool = PagePool(dec, num_slots=num_slots * pages_per_row,
+                    page_size=page_size,
+                    num_pages=num_slots * pages_per_row)
+    rng = np.random.default_rng(1)  # the _bench_serve request mix
+    admitted = 0
+    for i in range(pool.num_slots):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        budget = int(rng.integers(new_tokens // 4, new_tokens + 1))
+        try:
+            pool.acquire(Request(id=i, prompt=[1] * L,
+                                 max_new_tokens=budget, seed=i))
+        except SlotPoolFull:
+            break
+        admitted += 1
+    capacity = admitted / num_slots
+
+    # ---- prefix hit rate: shared system prompt through the engine -----
+    sys_prompt = [int(t) for t in
+                  np.random.default_rng(2).integers(0, 50257, size=prompt)]
+    eng = ServeEngine(dec, params, num_slots=4, prefill_len=prefill_chunk,
+                      page_size=page_size, prefill_chunk=prefill_chunk,
+                      prefix_cache=True)
+    tails = np.random.default_rng(3).integers(0, 50257,
+                                              size=(n_prefix, 8))
+    for i in range(n_prefix):
+        eng.prefill([Request(id=i,
+                             prompt=sys_prompt + [int(t) for t in tails[i]],
+                             max_new_tokens=4, seed=i)])
+        while eng.chunk_pending:
+            eng.prefill_chunk_step()
+        while eng.active_count:
+            eng.step()
+    hit_rate = eng.prefix.hit_rate
+    eng.shutdown()
+
+    # ---- decode stall: monolithic vs chunked long-prompt injection ----
+    shorts = [Request(id=100 + i, prompt=[3 + i] * 16, max_new_tokens=48,
+                      seed=100 + i) for i in range(3)]
+    long_toks = [int(t) for t in np.random.default_rng(4).integers(
+        0, 50257, size=long_prompt)]
+
+    def stall_run(chunked: bool) -> Histogram:
+        eng = ServeEngine(
+            dec, params, num_slots=4,
+            prefill_len=(prefill_chunk if chunked else max_len),
+            prefill_batch=4, page_size=page_size,
+            prefill_chunk=(prefill_chunk if chunked else None))
+        eng.prefill([Request(id=r.id, prompt=list(r.prompt),
+                             max_new_tokens=r.max_new_tokens, seed=r.seed)
+                     for r in shorts])
+        for _ in range(4):   # warm the step program + settle
+            eng.step()
+        gaps = Histogram("decode_gap_ms")
+        long_req = Request(id=999, prompt=long_toks, max_new_tokens=4,
+                           seed=999)
+        last = time.perf_counter()
+        eng.prefill([long_req])
+        while eng.chunk_pending or eng.active_count:
+            if eng.chunk_pending:
+                eng.prefill_chunk_step()
+            if eng.active_count:
+                eng.step()
+                now = time.perf_counter()
+                gaps.observe(1e3 * (now - last))
+                last = now
+        eng.shutdown()
+        return gaps
+
+    stall_run(True)   # compile both program sets outside the timing
+    stall_run(False)
+    chunked_gaps = stall_run(True)
+    mono_gaps = stall_run(False)
+    return {
+        "page_size": page_size,
+        "prefill_chunk": prefill_chunk,
+        "paged_concurrent_capacity": round(capacity, 2),
+        "paged_admissions": admitted,
+        "static_admissions": num_slots,
+        "prefix_cache_hit_rate": round(hit_rate, 3),
+        "decode_stall_p99_ms": round(chunked_gaps.quantile(0.99), 1),
+        "decode_stall_p99_ms_monolithic": round(
+            mono_gaps.quantile(0.99), 1),
+        "decode_stall_p50_ms": round(chunked_gaps.quantile(0.50), 1),
+        "long_prompt_len": long_prompt,
+    }
+
+
 def _bench_chaos(num_slots: int = 4, n_requests: int = 8,
                  prompt: int = 32, new_tokens: int = 32,
                  steps_per_dispatch: int = 4) -> dict:
@@ -1846,6 +1984,16 @@ def main() -> None:
         extras["serve"] = _bench_serve()
     except Exception as exc:
         extras["serve"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        # paged-KV additions: capacity per arena byte, prefix reuse,
+        # chunked-prefill decode-stall bound — untracked alongside the
+        # tracked serve_tokens_per_sec (the legacy dense trace above)
+        if isinstance(extras.get("serve"), dict) \
+                and "error" not in extras["serve"]:
+            extras["serve"].update(_bench_paged())
+    except Exception as exc:
+        extras["serve"]["paged_error"] = f"{type(exc).__name__}: {exc}"
 
     try:
         # serving under a pinned fault plan: recovery cost, untracked

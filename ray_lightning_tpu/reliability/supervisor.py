@@ -36,6 +36,17 @@ from ray_lightning_tpu.serve.request import (Completion, FINISH_FAILED,
                                              Request)
 
 
+def _failed(req: Request, tokens) -> Completion:
+    """The FINISH_FAILED retirement every recovery dead-end shares
+    (retries exhausted, unreplayable entry, shed replay wave): partial
+    tokens kept, timing carried over."""
+    return Completion(
+        request_id=req.id, prompt=list(req.prompt), tokens=list(tokens),
+        finish_reason=FINISH_FAILED, arrival_time=req.arrival_time,
+        first_token_time=req.first_token_time,
+        prefix_hit_tokens=req.prefix_hit_tokens)
+
+
 class ServeSupervisor:
     """Engine proxy: same dispatch surface, plus rebuild-and-replay.
 
@@ -80,12 +91,22 @@ class ServeSupervisor:
     def step(self) -> List[Completion]:
         return self._dispatch("step")
 
+    def prefill_chunk_step(self) -> List[Completion]:
+        # chunk dispatches are dispatches too: a crash mid-chunk enters
+        # the same rebuild-and-replay path (the half-prefilled prompt is
+        # in snapshot_in_flight with zero emitted tokens and re-feeds
+        # from scratch — chunked replay is token-identical, pinned by
+        # tests/test_paged.py)
+        return self._dispatch("chunk")
+
     def _dispatch(self, op: str,
                   requests: Sequence[Request] = ()) -> List[Completion]:
         from ray_lightning_tpu.serve.engine import SlotPoolFull
         try:
             if op == "prefill":
                 return self.engine.prefill(list(requests))
+            if op == "chunk":
+                return self.engine.prefill_chunk_step()
             return self.engine.step()
         except (SlotPoolFull, ValueError):
             # admission-contract errors (pool full, seed collision, shape
@@ -150,16 +171,11 @@ class ServeSupervisor:
                 ).inc()
             self.failed_requests += len(entries)
             self.recovery_s_total += time.perf_counter() - t0
-            return [
-                Completion(request_id=req.id, prompt=list(req.prompt),
-                           tokens=list(toks), finish_reason=FINISH_FAILED,
-                           arrival_time=req.arrival_time,
-                           first_token_time=req.first_token_time)
-                for req, toks in entries
-            ]
+            return [_failed(req, toks) for req, toks in entries]
 
     def _rebuild_and_replay(self, entries: List[Tuple[Request, List[int]]]
                             ) -> List[Completion]:
+        from ray_lightning_tpu.serve.engine import SlotPoolFull
         self.engine = self._engine_cls(self._model, self._params,
                                        **self._engine_kwargs)
         self.rebuilds += 1
@@ -176,22 +192,45 @@ class ServeSupervisor:
         done: List[Completion] = []
         pending: List[Request] = []
         for req, toks in entries:
-            if req.prompt_len + len(toks) > self.engine.prefill_len:
-                # prompt + emitted no longer fits one prefill pass: this
-                # request cannot be replayed (docs/reliability.md names
-                # the prefill_len >= prompt + expected tokens sizing
-                # rule); counted by _recover iff this attempt commits
-                done.append(Completion(
-                    request_id=req.id, prompt=list(req.prompt),
-                    tokens=list(toks), finish_reason=FINISH_FAILED,
-                    arrival_time=req.arrival_time,
-                    first_token_time=req.first_token_time))
+            if req.prompt_len + len(toks) > self.engine.max_replay_len:
+                # prompt + emitted no longer fits the engine's replay
+                # path — one prefill pass without chunking, the whole
+                # sequence axis with it (docs/reliability.md names the
+                # sizing rule); counted by _recover iff this attempt
+                # commits
+                done.append(_failed(req, toks))
                 continue
             req.replay_tokens = list(toks)
             pending.append(req)
-        B = self.engine.prefill_batch
-        for i in range(0, len(pending), B):
-            done.extend(self.engine.prefill(pending[i:i + B]))
+        # prefix-sharing engines replay ONE request per wave, draining
+        # its chunk prefill before the next admits: each completed
+        # replay republishes its prompt-prefix pages so the next wave
+        # adopts them exactly as the dead engine's tenants did — an
+        # all-at-once admission would demand every request's FULL page
+        # count and could overflow an arena the snapshot only fit by
+        # sharing. (Without a prefix cache the snapshot's page/slot
+        # demand is exactly its pre-crash demand, so batch waves fit —
+        # and their chunk queues are deliberately NOT drained here: the
+        # driving loop's normal chunk/decode alternation resumes them,
+        # keeping the one-chunk stall bound through recovery; pinned by
+        # tests/test_paged.py::test_chunked_replay_token_identity.)
+        prefix_replay = getattr(self.engine, "prefix", None) is not None
+        step = 1 if prefix_replay else self.engine.prefill_batch
+        for i in range(0, len(pending), step):
+            wave = pending[i:i + step]
+            try:
+                done.extend(self.engine.prefill(wave))
+            except SlotPoolFull:
+                # genuinely unseatable on the fresh engine (e.g. the
+                # dead engine's co-residency leaned on cache-held pages
+                # a drained replay cannot reconstruct): shed THIS wave,
+                # keep replaying the rest instead of exhausting retries
+                # on a deterministic refusal
+                done.extend(_failed(req, req.replay_tokens or ())
+                            for req in wave)
+                continue
+            while prefix_replay and self.engine.chunk_pending:
+                done.extend(self.engine.prefill_chunk_step())
         return done
 
 

@@ -24,9 +24,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ray_lightning_tpu.serve.engine import ServeEngine
 from ray_lightning_tpu.serve.request import (Completion, FINISH_REJECTED,
                                              FINISH_TIMEOUT, Request)
-from ray_lightning_tpu.serve.scheduler import (ACTION_PREFILL, ACTION_STEP,
-                                               FifoScheduler, QueueFull,
-                                               SchedulerConfig)
+from ray_lightning_tpu.serve.scheduler import (ACTION_CHUNK, ACTION_PREFILL,
+                                               ACTION_STEP, FifoScheduler,
+                                               QueueFull, SchedulerConfig)
 
 
 class ServeClient:
@@ -45,12 +45,18 @@ class ServeClient:
                  scheduler_config: Optional[SchedulerConfig] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
-                 retry_policy=None, telemetry=None):
+                 retry_policy=None, telemetry=None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = False):
         engine_kwargs = dict(
             num_slots=num_slots, prefill_batch=prefill_batch,
             prefill_len=prefill_len,
             steps_per_dispatch=steps_per_dispatch, seed=seed,
-            telemetry=telemetry)
+            telemetry=telemetry, page_size=page_size,
+            num_pages=num_pages, prefill_chunk=prefill_chunk,
+            prefix_cache=prefix_cache)
         if retry_policy is not None:
             # supervised engine: dispatch crashes rebuild + replay under
             # the policy instead of unwinding through the client loop;
@@ -66,6 +72,7 @@ class ServeClient:
         self._t0: Optional[float] = None
         self._ops = 0  # engine dispatches so far = the tick clock
         self._next_id = 0
+        self._seen_rebuilds = 0  # supervised: recovery TTFT sweep
         self.completions: Dict[int, Completion] = {}
         # telemetry is off by default: every armed emission below sits
         # behind `if tel is not None` — the disarmed loop pays one
@@ -116,6 +123,20 @@ class ServeClient:
             ).set(len(self.scheduler))
         return req.id
 
+    def _stamp_first_token(self, req: Request, t: float) -> None:
+        """First-token bookkeeping shared by every stamping path
+        (batched admit, final chunk, post-recovery sweep)."""
+        req.first_token_time = t
+        if self._tel is not None:
+            self._tel.event("serve.first_token", id=req.id,
+                            ttft=t - req.arrival_time)
+
+    def shutdown(self) -> None:
+        """Release the engine's KV pool/arena (and prefix-cache page
+        refs) — a retired client stops pinning device memory. Forwarded
+        through a supervising wrapper when ``retry_policy`` is set."""
+        self.engine.shutdown()
+
     # ------------------------------------------------------------- loop
     def tick(self) -> List[Completion]:
         """One scheduling decision + engine dispatch. Returns completions
@@ -158,21 +179,49 @@ class ServeClient:
                 done.extend(self.engine.prefill(admit))
                 self._ops += 1  # count the dispatch before stamping TTFT
                 t_first = self.now()
+                chunking = getattr(self.engine, "chunk_pending_ids",
+                                   frozenset())
                 for req in admit:
-                    req.first_token_time = t_first
-                    if tel is not None:
-                        tel.event("serve.first_token", id=req.id,
-                                  ttft=t_first - req.arrival_time)
-            elif self.engine.active_count:
-                done.extend(self.engine.step())
-                self._ops += 1
-            else:  # unreachable: an idle engine always admits the head
-                self._ops += 1
+                    if req.id in chunking:
+                        # chunk-routed: still prefilling, no first token
+                        # yet — stamped by _dispatch_chunk on its final
+                        # chunk
+                        continue
+                    self._stamp_first_token(req, t_first)
+            else:
+                # every popped request was seed-deferred: the tick must
+                # still advance the engine — the conflicting request may
+                # itself be chunk-prefilling (holding a slot with nothing
+                # decoding: livelock otherwise) — but under the SAME
+                # chunk/decode alternation bound as any other dispatch,
+                # so a persistent deferral can't starve in-flight decode;
+                # the substitute action falls through to the shared
+                # dispatch chain below
+                action = self.scheduler.drain_action(self.engine)
+        if action == ACTION_CHUNK:
+            self._dispatch_chunk(done)
         elif action == ACTION_STEP:
             done.extend(self.engine.step())
             self._ops += 1
-        else:  # idle: advance the tick clock so tick-mode traces progress
+        elif action != ACTION_PREFILL:
+            # idle: advance the tick clock so tick-mode traces progress
             self._ops += 1
+        rebuilds = getattr(self.engine, "rebuilds", 0)
+        if rebuilds != self._seen_rebuilds:
+            # a recovery may drain chunk prefills internally (prefix
+            # replay waves): a request it ACTIVATED got its first token
+            # inside the recovery, where _dispatch_chunk never saw the
+            # chunk_activated handoff — stamp TTFT now, not at retire
+            self._seen_rebuilds = rebuilds
+            t = self.now()
+            # skip requests the recovery RE-QUEUED mid-chunk (non-prefix
+            # replay): they have no token yet — _dispatch_chunk stamps
+            # them on their final chunk
+            chunking = getattr(self.engine, "chunk_pending_ids",
+                               frozenset())
+            for req in self.engine.active_requests.values():
+                if req.first_token_time is None and req.id not in chunking:
+                    self._stamp_first_token(req, t)
         t_done = self.now()
         for comp in done:
             comp.finish_time = t_done
@@ -185,6 +234,17 @@ class ServeClient:
         if tel is not None:
             self._record_retirements(tel, done)
         return done
+
+    def _dispatch_chunk(self, done: List[Completion]) -> None:
+        """One chunk-prefill dispatch, plus TTFT stamping for the request
+        (if any) whose final chunk just activated its decode row — the
+        engine hands it over directly (``chunk_activated``), no scan of
+        ``active_requests``."""
+        done.extend(self.engine.prefill_chunk_step())
+        self._ops += 1
+        req = self.engine.chunk_activated
+        if req is not None and req.first_token_time is None:
+            self._stamp_first_token(req, self.now())
 
     def _record_retirements(self, tel, done: List[Completion]) -> None:
         """Armed-path bookkeeping for one tick: retire events + the
@@ -227,12 +287,26 @@ class ServeClient:
         m.gauge("serve_slot_occupancy",
                 help="fraction of KV slots holding an in-flight request"
                 ).set(self.engine.active_count / self.num_slots)
+        pages_free = getattr(self.engine, "free_pages", None)
+        if pages_free is not None:
+            num_pages = self.engine.pool.num_pages
+            m.gauge("serve_pages_free",
+                    help="free KV pages in the paged arena"
+                    ).set(pages_free)
+            m.gauge("serve_page_occupancy",
+                    help="fraction of arena pages held (slots + prefix "
+                    "cache)").set(1.0 - pages_free / num_pages)
+
+    def _engine_busy(self) -> bool:
+        """Decode rows active OR prompts still streaming chunk prefill."""
+        return bool(self.engine.active_count
+                    or getattr(self.engine, "chunk_pending", 0))
 
     def run_until_idle(self, max_ticks: int = 100_000) \
             -> Dict[int, Completion]:
         """Tick until queue and slots drain; returns all completions."""
         ticks = 0
-        while len(self.scheduler) or self.engine.active_count:
+        while len(self.scheduler) or self._engine_busy():
             self.tick()
             ticks += 1
             if ticks > max_ticks:
@@ -259,7 +333,7 @@ class ServeClient:
         idx = 0
         ticks = 0
         while (idx < len(pending) or len(self.scheduler)
-               or self.engine.active_count):
+               or self._engine_busy()):
             now = self.now()
             while idx < len(pending) and pending[idx][0] <= now:
                 kwargs = pending[idx][1]
@@ -281,7 +355,7 @@ class ServeClient:
                             help="requests shed at admission").inc()
                 idx += 1
             if (idx < len(pending) and not len(self.scheduler)
-                    and not self.engine.active_count):
+                    and not self._engine_busy()):
                 # nothing in flight and the next arrival is in the
                 # future: fast-forward the tick clock / yield the wall
                 # clock instead of spinning

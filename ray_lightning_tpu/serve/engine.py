@@ -1,18 +1,17 @@
-"""Continuous-batching serving engine: slot-pooled KV cache + one step
-program for all in-flight requests.
+"""Continuous-batching serving engine: paged (or slot-pooled) KV cache +
+fixed-shape compiled programs for all in-flight requests.
 
-Iteration-level scheduling (Orca, OSDI '22) on XLA's terms: instead of a
-static batch that waits for its slowest member, the engine owns a fixed
-pool of **KV slots** — rows of one pre-allocated ``(B, max_seq_len, H, D)``
-cache — and exactly TWO pre-compiled fixed-shape programs, reusing the
+Iteration-level scheduling (Orca, OSDI '22) on XLA's terms: the engine
+owns a fixed batch of **KV slots** (rows of the decode step program) and
+a small set of pre-compiled fixed-shape programs, reusing the
 prefill/decode split from :mod:`ray_lightning_tpu.models.generate`:
 
 1. **prefill+inject** (``(B_pf, P)`` static shape): batch up to ``B_pf``
    waiting prompts, run the existing single-pass
    :func:`~ray_lightning_tpu.models.generate._prefill_impl` forward,
    sample each row's first token with its own key/params, and write each
-   prefilled KV row into its assigned pool slot (a per-row
-   ``dynamic_update_slice`` along the cache's batch axis).
+   prefilled KV row into its assigned slot (dense path) or scatter its
+   pages into the arena (paged path).
 2. **step** (``(B, 1)`` static shape): ONE cached decode step for all B
    slots at their own ``kv_positions`` — the factored
    :func:`~ray_lightning_tpu.models.generate.decode_step` that
@@ -22,34 +21,60 @@ prefill/decode split from :mod:`ray_lightning_tpu.models.generate`:
    and latches its own eos — finished rows retire *mid-flight* and their
    slots are handed to the next queued request without recompiling
    anything (all shapes static).
+3. **chunk prefill** (``(1, C)`` static shape, paged engines with
+   ``prefill_chunk`` set): ONE ``C``-token piece of one prompt, written
+   at that request's current offset with chunk-causal masking over its
+   already-filled pages — long prompts stream in chunk-sized dispatches
+   the scheduler interleaves with decode, so a 4k-token prompt stalls
+   in-flight decodes by one chunk, not one prompt (Sarathi-style chunked
+   prefill). Prefix-cache hits enter here too: adopted pages skip
+   straight to the first un-cached offset.
 
-This is vLLM-style paged KV management simplified to whole-sequence slots:
-XLA wants static shapes, so the page size is "one request's max context"
-and the pool is the batch dimension. See ``docs/serving.md`` for the slot
-lifecycle and the rationale vs. finer-grained paging.
+KV layout is split from the programs (the refactor ROADMAP item 1 calls
+healthy): the *logical* per-slot ``(max_seq_len, H, D)`` KV each program
+computes against is materialized from physical storage at dispatch time.
+Dense storage (``page_size=None``) IS the logical layout — one
+``(num_slots, max_seq_len, H, D)`` pool, the original static-slot
+design. Paged storage (:class:`~ray_lightning_tpu.serve.pages.PagePool`)
+is a ``(num_pages, page_size, H, D)`` arena per KV leaf plus a per-slot
+page table; the programs stay the same fixed-shape jits — the page
+table is just a gather index applied on the way in and a scatter index
+on the way out, fused into the dispatch. See ``docs/serving.md`` for
+the memory/bandwidth trade and the production endgame (gather folded
+into a pallas paged-attention kernel).
 
-Inactive slots still flow through the step program (the batch is static);
-they are masked out of sampling/bookkeeping and their parked KV rewrite is
-idempotent, so they cost FLOPs but never correctness. Keep ``num_slots``
-near your live-traffic working set.
+Inactive slots still flow through the step program (the batch is
+static); they are masked out of sampling/bookkeeping and their parked
+KV rewrite is idempotent (dense) or dropped by the scatter (paged), so
+they cost FLOPs but never correctness. Keep ``num_slots`` near your
+live-traffic working set — paged engines can afford a generous batch
+because slots no longer reserve memory.
 """
 from __future__ import annotations
 
+import warnings
+from collections import deque
+from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Deque, FrozenSet, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_lightning_tpu.models.generate import (_prefill_impl, decode_step,
+from ray_lightning_tpu.models.generate import (_logits_only, _prefill_impl,
+                                               decode_step,
                                                sample_logits_rows)
 from ray_lightning_tpu.models.transformer import latch_eos
 from ray_lightning_tpu.obs.spans import NULL_SPAN
 from ray_lightning_tpu.reliability import faults
+from ray_lightning_tpu.serve.pages import (PagePool, PrefixCache,
+                                           SlotPoolFull, check_seed_free)
 from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_LENGTH, FINISH_TIMEOUT,
                                              Request)
+
+__all__ = ["ServeEngine", "KVSlotPool", "SlotPoolFull"]
 
 
 def _fold_rows(keys: jax.Array, data: jax.Array) -> jax.Array:
@@ -121,7 +146,7 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
 
 def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
                          slots, valid, keys, temp, top_k, startno):
-    """Batched prompt fill + first-token sample + KV injection.
+    """Batched prompt fill + first-token sample + KV injection (dense).
 
     Runs the standard single-pass prefill at the engine's fixed
     ``(B_pf, P)`` shape (rows left-aligned, ``lengths`` raggedness — the
@@ -173,6 +198,149 @@ def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
     return pool_cache, first
 
 
+# --------------------------------------------------------------- paged
+def _page_axis(model) -> int:
+    # arena/cache leaves: (pages|B, seq, H, D) unrolled or
+    # (n_layers, pages|B, seq, H, D) scanned — page axis == batch axis
+    return 1 if model.cfg.scan_layers else 0
+
+
+def _arena_pages(model, arena) -> int:
+    axis = _page_axis(model)
+    return next(leaf.shape[axis]
+                for leaf in jax.tree_util.tree_leaves(arena)
+                if leaf.ndim >= 4)
+
+
+def _gather_pages(model, arena, page_table):
+    """Materialize the dense per-slot KV view from the arena: one gather
+    per KV leaf, ``(S, pp)`` page table → ``(S, pp * page_size, …)``
+    rows. Unmapped (−1) entries clamp to page 0 — finite stale bytes the
+    per-row attention mask never admits (every attended position lies in
+    a mapped page by construction) and the scatter never writes back."""
+    axis = _page_axis(model)
+    S, pp = page_table.shape
+    idx = jnp.maximum(page_table.reshape(-1), 0)
+
+    def gather(leaf):
+        if leaf.ndim < 4:
+            return leaf
+        pages = jnp.take(leaf, idx, axis=axis)
+        shape = list(pages.shape)
+        shape[axis:axis + 2] = [S, pp * shape[axis + 1]]
+        return pages.reshape(shape)
+
+    return jax.tree_util.tree_map(gather, arena)
+
+
+def _scatter_pages(model, arena, view, page_table):
+    """Write the dense view's rows back to their arena pages (inverse of
+    :func:`_gather_pages`). Unmapped entries scatter to a dropped
+    out-of-range index. Pages shared between slots (refcounted prefix
+    pages) receive identical values from every holder — nothing writes
+    inside an adopted page (decode and chunk writes land at positions
+    past the shared prefix) — so duplicate indices stay deterministic."""
+    axis = _page_axis(model)
+    num_pages = _arena_pages(model, arena)
+    S, pp = page_table.shape
+    pt = page_table.reshape(-1)
+    idx = jnp.where(pt >= 0, pt, num_pages)
+
+    def scatter(arena_leaf, view_leaf):
+        if arena_leaf.ndim < 4:
+            return arena_leaf
+        ps = arena_leaf.shape[axis + 1]
+        shape = list(view_leaf.shape)
+        shape[axis:axis + 2] = [S * pp, ps]
+        pages = view_leaf.reshape(shape)
+        if axis == 0:
+            return arena_leaf.at[idx].set(pages, mode="drop")
+        return arena_leaf.at[:, idx].set(pages, mode="drop")
+
+    return jax.tree_util.tree_map(scatter, arena, view)
+
+
+def _paged_step_impl(model, params, arena, page_table, cur, pos, active,
+                     remaining, temp, top_k, eos, keys, stepno, *, steps):
+    """The decode step program on paged storage: gather the dense view,
+    run the IDENTICAL multi-step body (:func:`_engine_step_impl` — token
+    identity with the dense engine is by construction), scatter mapped
+    pages back. One dispatch, fused by XLA; the view is dispatch-scoped
+    scratch, the arena is the only persistent KV allocation.
+
+    Only rows active at dispatch entry scatter back. Inactive rows run
+    the same math (static shapes) and "write" their frozen K/V at a
+    stale position — dead storage on the dense path, but here the slot's
+    pages may belong to a request still streaming chunk prefill (a
+    mid-chunking slot is allocated but not yet decoding), so their
+    writes must be dropped, not parked. Rows that retire mid-block
+    started active and still scatter: their post-retirement sub-step
+    rewrites are frozen-idempotent.
+    """
+    view = _gather_pages(model, arena, page_table)
+    write_pt = jnp.where(active[:, None], page_table, -1)
+    (view, cur, pos, active, remaining, stepno, emitted, finished) = \
+        _engine_step_impl(model, params, view, cur, pos, active,
+                          remaining, temp, top_k, eos, keys, stepno,
+                          steps=steps)
+    arena = _scatter_pages(model, arena, view, write_pt)
+    return (arena, cur, pos, active, remaining, stepno, emitted, finished)
+
+
+def _prefill_inject_paged_impl(model, params, arena, prompts, lengths,
+                               inject_pt, keys, temp, top_k, startno):
+    """Paged sibling of :func:`_prefill_inject_impl`: same prefill
+    forward and first-token sample, but the injection is a page scatter —
+    ``inject_pt`` (B_pf, pages_per_slot) maps each prefill row's pages to
+    arena pages (−1 = drop: padding rows, and the unmapped tail of a
+    short request's slot). The prefill cache covers the full
+    ``max_seq_len`` row (positions ≥ P are zeros), so every mapped page
+    is overwritten — stale KV from the pages' previous tenants never
+    leaks (the paged analog of the dense whole-row inject)."""
+    pf_cache, last = _prefill_impl(model, params, prompts, lengths)
+    first_keys = _fold_rows(keys, startno)
+    first = sample_logits_rows(last, first_keys, temp, top_k)
+    # the prefill cache rows are already the dense per-slot view
+    # (B_pf, max_seq_len, …) = (S, pp * page_size, …)
+    arena = _scatter_pages(model, arena, pf_cache, inject_pt)
+    return arena, first
+
+
+def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
+                        valid_len, keys, temp, top_k, startno):
+    """One ``(1, C)`` chunk of one prompt, at absolute ``offset``.
+
+    Gathers the request's dense row view from its pages, points the
+    shared ``cache_index`` bookkeeping at ``offset`` (the block-write
+    mode of ``_decode_cache`` then writes this chunk's K/V there and
+    masks keys past ``offset + q`` per intra-chunk query — chunk-causal
+    attention over everything already filled, including adopted prefix
+    pages), runs the forward, scatters mapped pages back, and samples a
+    candidate first token from the logits at ``valid_len - 1``. The host
+    uses that sample only on the final chunk; earlier chunks discard it
+    (one program covers every chunk). ``startno`` continues a replayed
+    request's key stream, exactly as the batched prefill does.
+    """
+    pt = row_pages[None, :]
+    view = _gather_pages(model, arena, pt)
+    view = jax.tree_util.tree_map(
+        lambda leaf: (jnp.full(leaf.shape, offset, leaf.dtype)
+                      if leaf.ndim < 4 else leaf), view)
+    C = tokens.shape[1]
+    positions = offset + jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+    outputs, updated = model.apply(
+        {"params": params, "cache": view}, tokens, positions=positions,
+        deterministic=True, mutable=["cache"])
+    logits = _logits_only(outputs)                      # (1, C, V)
+    last = jnp.take_along_axis(
+        logits, jnp.reshape(valid_len - 1, (1, 1, 1)).astype(jnp.int32),
+        axis=1)[:, 0]
+    first = sample_logits_rows(last, _fold_rows(keys, startno), temp,
+                               top_k)
+    arena = _scatter_pages(model, arena, updated["cache"], pt)
+    return arena, first
+
+
 _engine_step_donated = partial(
     jax.jit, static_argnames=("model", "steps"), donate_argnums=(2,))(
         _engine_step_impl)
@@ -183,6 +351,21 @@ _prefill_inject_donated = partial(
         _prefill_inject_impl)
 _prefill_inject_plain = partial(
     jax.jit, static_argnames=("model",))(_prefill_inject_impl)
+_paged_step_donated = partial(
+    jax.jit, static_argnames=("model", "steps"), donate_argnums=(2,))(
+        _paged_step_impl)
+_paged_step_plain = partial(
+    jax.jit, static_argnames=("model", "steps"))(_paged_step_impl)
+_prefill_paged_donated = partial(
+    jax.jit, static_argnames=("model",), donate_argnums=(2,))(
+        _prefill_inject_paged_impl)
+_prefill_paged_plain = partial(
+    jax.jit, static_argnames=("model",))(_prefill_inject_paged_impl)
+_chunk_prefill_donated = partial(
+    jax.jit, static_argnames=("model",), donate_argnums=(2,))(
+        _chunk_prefill_impl)
+_chunk_prefill_plain = partial(
+    jax.jit, static_argnames=("model",))(_chunk_prefill_impl)
 
 
 def _pick(donated, plain):
@@ -191,12 +374,10 @@ def _pick(donated, plain):
     return plain if jax.default_backend() == "cpu" else donated
 
 
-class SlotPoolFull(RuntimeError):
-    """No free KV slot — admission control should have prevented this."""
-
-
 class KVSlotPool:
-    """Owns the (B, max_seq_len) KV cache and the request → slot map.
+    """Dense storage: owns the (B, max_seq_len) KV cache and the
+    request → slot map (the original static-slot layout; the paged
+    sibling is :class:`~ray_lightning_tpu.serve.pages.PagePool`).
 
     Slots are acquired at prefill injection and released on
     eos/max-token/timeout; lowest-index-first allocation keeps traces
@@ -230,14 +411,9 @@ class KVSlotPool:
     def acquire(self, request: Request) -> int:
         if not self._free:
             raise SlotPoolFull(
-                f"all {self.num_slots} KV slots in use")
-        for req in self._requests.values():
-            if req.seed == request.seed:
-                raise ValueError(
-                    f"PRNG key reuse across slots: request {request.id} "
-                    f"and in-flight request {req.id} share seed "
-                    f"{request.seed} — co-resident sample streams would "
-                    "collide; give one an explicit distinct seed")
+                f"all {self.num_slots} KV slots in use",
+                slots_free=0, active=len(self._requests))
+        check_seed_free(self._requests, request)
         slot = self._free.pop(0)
         self._requests[slot] = request
         return slot
@@ -249,27 +425,55 @@ class KVSlotPool:
         return req
 
 
+@dataclass
+class _ChunkState:
+    """One mid-chunking prompt: the slot is held, pages are allocated,
+    and ``fed[next_off:]`` still has to stream through the chunk
+    program."""
+    request: Request
+    slot: int
+    fed: List[int]       # prompt + replayed tokens
+    next_off: int        # first position not yet written (admission
+    #                      seeds it past any adopted prefix pages)
+
+
 class ServeEngine:
-    """In-flight batching over a fixed KV slot pool.
+    """In-flight batching over a fixed slot batch with dense or paged KV.
 
     ``model`` must be a decode-mode LM (``cfg.decode=True``; for serving
     throughput build it ``scan_layers=False`` and convert training weights
     with ``unstack_scan_params`` — see ``docs/performance.md``). The
-    engine compiles two programs on first use and never again:
-    prefill+inject at ``(prefill_batch, prefill_len)`` and the decode step
-    at ``(num_slots, 1)``.
+    engine compiles its programs on first use and never again:
+    prefill+inject at ``(prefill_batch, prefill_len)``, the decode step
+    at ``(num_slots, 1)``, and (chunked engines) the chunk prefill at
+    ``(1, prefill_chunk)``.
+
+    Paged mode (``page_size=``): KV lives in a
+    ``(num_pages, page_size, H, D)`` arena behind a per-slot page table
+    (:class:`~ray_lightning_tpu.serve.pages.PagePool`) — short requests
+    hold pages for their own prompt+budget instead of a ``max_seq_len``
+    row, so concurrency (``num_slots``) decouples from KV memory
+    (``num_pages``). ``prefill_chunk=`` streams long prompts in
+    chunk-sized dispatches the scheduler interleaves with decode;
+    ``prefix_cache=True`` adds refcounted read-only reuse of
+    shared-prompt KV pages (requires ``prefill_chunk`` — adopted chains
+    resume at the first un-cached offset, which is a chunk dispatch).
 
     Drive it with :class:`~ray_lightning_tpu.serve.client.ServeClient`
     (scheduler + admission control + clocks) or directly:
-    ``prefill([reqs])`` to start requests, ``step()`` to advance every
-    in-flight request one token; both return newly finished
-    :class:`Completion`\\ s.
+    ``prefill([reqs])`` to start requests (chunk-routed prompts advance
+    via ``prefill_chunk_step()``), ``step()`` to advance every in-flight
+    request; each returns newly finished :class:`Completion`\\ s.
     """
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  prefill_batch: Optional[int] = None,
                  prefill_len: int = 64, steps_per_dispatch: int = 1,
-                 seed: int = 0, telemetry=None):
+                 seed: int = 0, telemetry=None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = False):
         cfg = model.cfg
         if not cfg.decode:
             raise ValueError(
@@ -285,20 +489,75 @@ class ServeEngine:
             raise ValueError(
                 f"steps_per_dispatch must be >= 1, got "
                 f"{steps_per_dispatch}")
+        if page_size is None and (num_pages is not None
+                                  or prefill_chunk is not None
+                                  or prefix_cache):
+            raise ValueError(
+                "num_pages / prefill_chunk / prefix_cache are paged-KV "
+                "features: pass page_size= to enable the page arena")
+        if prefill_chunk is not None:
+            if prefill_chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {prefill_chunk}")
+            if prefill_chunk % page_size != 0:
+                raise ValueError(
+                    f"prefill_chunk ({prefill_chunk}) must be a multiple "
+                    f"of page_size ({page_size})")
+            if cfg.max_seq_len % prefill_chunk != 0:
+                raise ValueError(
+                    f"prefill_chunk ({prefill_chunk}) must divide "
+                    f"max_seq_len ({cfg.max_seq_len}) so chunk offsets "
+                    "can never overflow the sequence axis")
+        if prefix_cache and prefill_chunk is None:
+            raise ValueError(
+                "prefix_cache=True needs prefill_chunk= too: an adopted "
+                "prefix resumes prefill at its first un-cached offset, "
+                "which is a chunk-program dispatch")
         self.model = model
         self.params = params
         self.num_slots = num_slots
-        self.prefill_batch = max(1, min(prefill_batch or num_slots,
-                                        num_slots))
+        if prefill_batch is not None and prefill_batch < 1:
+            raise ValueError(
+                f"prefill_batch must be >= 1, got {prefill_batch}")
+        self.prefill_batch = min(prefill_batch or num_slots, num_slots)
+        if prefill_batch is not None and self.prefill_batch != prefill_batch:
+            # the silent clamp bit people: a caller asking for a bigger
+            # batch than the engine can inject deserves to know the
+            # compiled shape they actually got
+            warnings.warn(
+                f"prefill_batch={prefill_batch} clamped to "
+                f"{self.prefill_batch} (valid range 1..num_slots="
+                f"{num_slots}); the prefill program compiles at the "
+                "clamped shape", stacklevel=2)
+            if telemetry is not None:
+                telemetry.event("engine.config_clamped",
+                                field="prefill_batch",
+                                requested=prefill_batch,
+                                effective=self.prefill_batch)
         self.prefill_len = prefill_len
         # >1 = multi-step scheduling: K decode steps per program dispatch
         # (amortizes the fixed per-call overhead; requests join/retire at
         # K-token granularity) — see _engine_step_impl
         self.steps_per_dispatch = steps_per_dispatch
+        self.prefill_chunk = prefill_chunk
         # off by default; one attribute read + None check per dispatch
         # when disarmed (docs/observability.md)
         self._tel = telemetry
-        self.pool = KVSlotPool(model, num_slots)
+        self.paged = page_size is not None
+        if self.paged:
+            self.pool = PagePool(model, num_slots, page_size,
+                                 num_pages=num_pages)
+        else:
+            self.pool = KVSlotPool(model, num_slots)
+        if prefix_cache:
+            self.prefix = PrefixCache(self.pool)
+        else:
+            self.prefix = None
+        self._chunk_queue: Deque[_ChunkState] = deque()
+        # the request whose FINAL chunk the last prefill_chunk_step
+        # dispatch activated into decode (None otherwise) — the driving
+        # client stamps TTFT off this without scanning active_requests
+        self.chunk_activated: Optional[Request] = None
         self._base_key = jax.random.PRNGKey(seed)
 
         B = num_slots
@@ -318,12 +577,19 @@ class ServeEngine:
         self.steps = 0
         self.decode_substeps = 0
         self.prefills = 0
+        self.chunk_dispatches = 0
         self.tokens_generated = 0
 
     # ------------------------------------------------------------- state
     @property
     def free_slots(self) -> int:
         return self.pool.free_slots
+
+    @property
+    def free_pages(self) -> Optional[int]:
+        """Free arena pages, or None on the dense path (the client's
+        occupancy gauges key off this)."""
+        return self.pool.free_pages if self.paged else None
 
     @property
     def active_count(self) -> int:
@@ -333,10 +599,30 @@ class ServeEngine:
     def active_requests(self) -> Dict[int, Request]:
         return self.pool.active
 
+    @property
+    def chunk_pending(self) -> int:
+        """Prompts admitted but still streaming through chunk prefill."""
+        return len(self._chunk_queue)
+
+    @property
+    def chunk_pending_ids(self) -> FrozenSet[int]:
+        return frozenset(st.request.id for st in self._chunk_queue)
+
+    @property
+    def max_replay_len(self) -> int:
+        """Longest prompt + already-emitted-tokens sequence a crash
+        recovery can re-feed: one batched prefill pass without chunking,
+        the whole sequence axis with it (chunked replay streams any
+        admissible request back in — see docs/reliability.md)."""
+        if self.prefill_chunk is not None:
+            return self.model.cfg.max_seq_len
+        return self.prefill_len
+
     def validate(self, request: Request) -> None:
         """Admission check: the request must fit the compiled shapes."""
         cfg = self.model.cfg
-        if request.prompt_len > self.prefill_len:
+        if self.prefill_chunk is None \
+                and request.prompt_len > self.prefill_len:
             raise ValueError(
                 f"prompt length {request.prompt_len} exceeds the engine's "
                 f"prefill_len ({self.prefill_len})")
@@ -345,11 +631,91 @@ class ServeEngine:
                 f"prompt ({request.prompt_len}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds max_seq_len "
                 f"({cfg.max_seq_len})")
+        if self.paged:
+            need = self.pool.pages_needed(request)
+            if need > self.pool.num_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages (prompt "
+                    f"{request.prompt_len} + max_new_tokens "
+                    f"{request.max_new_tokens} at page_size "
+                    f"{self.pool.page_size}) but the arena only has "
+                    f"{self.pool.num_pages} — it can never be admitted")
+
+    # ------------------------------------------------------- admission
+    def _routes_chunked(self, request: Request) -> bool:
+        """Chunk-prefill routing: everything when the prefix cache is on
+        (published pages must all come from the one chunk program), else
+        prompts longer than a chunk (bounded decode stall) or longer
+        than the batched program can take at all."""
+        if self.prefill_chunk is None:
+            return False
+        if self.prefix is not None:
+            return True
+        fed = request.prompt_len + len(request.replay_tokens or ())
+        return fed > self.prefill_chunk or fed > self.prefill_len
+
+    def _chunk_floor(self, pages: int) -> int:
+        """Round a page count down to a whole number of chunks — the ONE
+        place the chunk-alignment cap lives, shared by adoption and the
+        hit-rate denominator so they can't drift apart."""
+        per_chunk = self.prefill_chunk // self.pool.page_size
+        return (pages // per_chunk) * per_chunk
+
+    def _adoptable_prefix(self, fed: List[int]) -> List[int]:
+        """Cached pages this admission may adopt: the matched chain
+        capped to a whole number of chunks, so the resumed prefill
+        starts on a chunk boundary and chunk writes can never touch a
+        shared page (offsets stay multiples of prefill_chunk, which the
+        sequence axis is a multiple of — no clamped-write rebasing)."""
+        if self.prefix is None:
+            return []
+        matched = self.prefix.match(fed)
+        return matched[:self._chunk_floor(len(matched))]
+
+    def admissible_prefix(self, requests: List[Request]) -> int:
+        """How many of the queue-head ``requests`` this engine can admit
+        in one prefill call (FIFO — the count is a prefix, never a
+        skip-ahead): slots, the batched program's width, and (paged)
+        cumulative page demand against free + cache-evictable pages.
+        Page accounting is conservative: prefix hits are counted as
+        consuming their pages (adoption pins them un-evictable), never
+        as a discount."""
+        limit = min(len(requests), self.free_slots)
+        if not self.paged:
+            return min(limit, self.prefill_batch)
+        budget = self.pool.free_pages + (self.prefix.evictable()
+                                         if self.prefix is not None else 0)
+        n = batched = 0
+        for req in requests[:limit]:
+            if not self._routes_chunked(req):
+                if batched == self.prefill_batch:
+                    break
+            need = self.pool.pages_needed(req)
+            if need > budget:
+                break
+            budget -= need
+            batched += not self._routes_chunked(req)
+            n += 1
+        return n
+
+    def _admit_paged(self, request: Request, adopt: List[int]) -> int:
+        """Acquire slot + pages for one paged admission, evicting
+        cache-only pages (protecting the chain being adopted) when the
+        free list runs short."""
+        fresh_need = self.pool.pages_needed(request) - len(adopt)
+        short = fresh_need - self.pool.free_pages
+        if short > 0 and self.prefix is not None:
+            self.prefix.evict(short, protect=adopt)
+        return self.pool.acquire(request, adopt)
 
     # ---------------------------------------------------------- programs
     def prefill(self, requests: List[Request]) -> List[Completion]:
-        """Start ``requests``: one fixed-shape prefill pass, first tokens
-        sampled, KV rows injected into freshly acquired slots. Returns
+        """Start ``requests``: slots (and pages) are acquired atomically
+        for the whole batch, then prompts short enough for the batched
+        program run one fixed-shape prefill pass (first tokens sampled,
+        KV injected); chunk-routed prompts (longer than ``prefill_chunk``
+        or any prompt under a prefix cache) are queued for
+        :meth:`prefill_chunk_step` dispatches instead. Returns
         completions for requests that finish ON their first token
         (eos-on-first or an exhausted budget).
 
@@ -362,37 +728,73 @@ class ServeEngine:
         if not requests:
             return []
         faults.fire("serve.dispatch")
-        if len(requests) > min(self.free_slots, self.prefill_batch):
+        n_batched = sum(not self._routes_chunked(r) for r in requests)
+        if n_batched > self.prefill_batch \
+                or len(requests) > self.free_slots:
             raise SlotPoolFull(
-                f"{len(requests)} requests > min(free_slots="
-                f"{self.free_slots}, prefill_batch={self.prefill_batch})")
+                f"{len(requests)} requests ({n_batched} batched) > "
+                f"min(free_slots={self.free_slots}, prefill_batch="
+                f"{self.prefill_batch})",
+                slots_free=self.free_slots,
+                pages_free=self.free_pages,
+                active=len(self.pool.active))
         B_pf, P = self.prefill_batch, self.prefill_len
         prompts = np.zeros((B_pf, P), np.int32)
         lengths = np.ones((B_pf,), np.int32)
         valid = np.zeros((B_pf,), bool)
         slots = np.zeros((B_pf,), np.int32)
+        inject_pt = np.full(
+            (B_pf, self.pool.pages_per_slot if self.paged else 1), -1,
+            np.int32)
         keys = np.zeros((B_pf, 2), np.uint32)
         temp = np.zeros((B_pf,), np.float32)
         top_k = np.zeros((B_pf,), np.int32)
         startno = np.zeros((B_pf,), np.int32)
-        acquired = []
+        acquired: List[int] = []
+        batched: List[Request] = []
+        adoptions: List[Tuple[int, int, Request]] = []
+        n_chunked = 0
         try:
-            for r, req in enumerate(requests):
+            for req in requests:
                 self.validate(req)
                 replay = list(req.replay_tokens or ())
-                L = req.prompt_len + len(replay)
+                fed = list(req.prompt) + replay
+                if self._routes_chunked(req):
+                    # chunk routing requires the page arena (__init__
+                    # refuses prefill_chunk without page_size)
+                    adopt = self._adoptable_prefix(fed)
+                    slot = self._admit_paged(req, adopt)
+                    acquired.append(slot)
+                    hit = len(adopt) * self.pool.page_size
+                    req.prefix_hit_tokens = hit
+                    self._chunk_queue.append(_ChunkState(
+                        request=req, slot=slot, fed=fed, next_off=hit))
+                    n_chunked += 1
+                    if self.prefix is not None:
+                        # eligible = what a fully warm cache could have
+                        # served under the same chunk-alignment cap
+                        eligible = self._chunk_floor(
+                            (len(fed) - 1) // self.pool.page_size)
+                        adoptions.append((eligible, len(adopt), req))
+                    continue
+                L = len(fed)
                 if L > self.prefill_len:
                     raise ValueError(
                         f"request {req.id}: prompt ({req.prompt_len}) + "
                         f"replayed tokens ({len(replay)}) exceed "
                         f"prefill_len ({self.prefill_len}) — not "
                         "resumable in one prefill pass")
-                slot = self.pool.acquire(req)
+                slot = (self._admit_paged(req, [])
+                        if self.paged else self.pool.acquire(req))
                 acquired.append(slot)
-                prompts[r, :L] = list(req.prompt) + replay
+                r = len(batched)
+                batched.append(req)
+                prompts[r, :L] = fed
                 lengths[r] = L
                 valid[r] = True
                 slots[r] = slot
+                if self.paged:
+                    inject_pt[r] = self.pool.page_table[slot]
                 keys[r] = np.asarray(
                     jax.random.fold_in(self._base_key, req.seed))
                 temp[r] = req.temperature
@@ -400,51 +802,140 @@ class ServeEngine:
                 startno[r] = len(replay)
         except Exception:
             # atomic admission: a mid-batch reject (seed collision, bad
-            # shape) must not leak the slots already acquired
+            # shape, page shortage) must not leak the slots/pages/chunk
+            # seats already acquired. Resources only: prefix-cache
+            # entries evicted to seat earlier batch members stay evicted
+            # (their pages may already be re-acquired) — a retried batch
+            # loses some cache warmth, never tokens
             for slot in acquired:
                 self.pool.release(slot)
+            for _ in range(n_chunked):
+                self._chunk_queue.pop()
             raise
-        # padding rows target a real slot but carry valid=False — the
-        # inject keeps the pool row, so they write nowhere
-        for r in range(len(requests), B_pf):
+        # stats/telemetry only once the whole batch's admission held —
+        # rolled-back admissions never count as hits or misses
+        for eligible, adopted, req in adoptions:
+            self.prefix.record_admission(eligible, adopted)
+            if self._tel is not None and adopted:
+                self._tel.event(
+                    "engine.prefix_hit", id=req.id, pages=adopted,
+                    tokens=adopted * self.pool.page_size)
+                self._tel.metrics.counter(
+                    "serve_prefix_pages_reused_total",
+                    help="KV pages adopted from the prefix cache"
+                ).inc(adopted)
+
+        if not batched:
+            return []
+        # padding rows of the dense path target a real slot but carry
+        # valid=False — the inject keeps the pool row, so they write
+        # nowhere (paged padding rows are all-(−1) scatter drops)
+        for r in range(len(batched), B_pf):
             slots[r] = acquired[0]
 
         tel = self._tel
-        fn = _pick(_prefill_inject_donated, _prefill_inject_plain)
-        with (tel.span("engine.prefill", n=len(requests))
+        with (tel.span("engine.prefill", n=len(batched))
               if tel is not None else NULL_SPAN):
-            self.pool.cache, first = fn(
-                self.model, self.params, self.pool.cache, prompts,
-                lengths, slots, valid, keys, temp, top_k, startno)
+            if self.paged:
+                fn = _pick(_prefill_paged_donated, _prefill_paged_plain)
+                self.pool.arena, first = fn(
+                    self.model, self.params, self.pool.arena, prompts,
+                    lengths, inject_pt, keys, temp, top_k, startno)
+            else:
+                fn = _pick(_prefill_inject_donated, _prefill_inject_plain)
+                self.pool.cache, first = fn(
+                    self.model, self.params, self.pool.cache, prompts,
+                    lengths, slots, valid, keys, temp, top_k, startno)
             first = np.asarray(first)
         if tel is not None:
-            tel.event("engine.prefill", n=len(requests),
-                      ids=[r.id for r in requests],
-                      slots=[int(s) for s in acquired])
+            tel.event("engine.prefill", n=len(batched),
+                      ids=[r.id for r in batched],
+                      slots=[int(slots[r]) for r in range(len(batched))])
 
         done: List[Completion] = []
-        for r, req in enumerate(requests):
-            slot = acquired[r]
-            tok = int(first[r])
-            toks = list(req.replay_tokens or ()) + [tok]
-            self._tokens[slot] = toks
-            self.tokens_generated += 1
-            hit_eos = req.eos_id is not None and tok == req.eos_id
-            if hit_eos or len(toks) >= req.max_new_tokens:
-                done.append(self._retire(
-                    slot, FINISH_EOS if hit_eos else FINISH_LENGTH))
-                continue
-            self._cur[slot, 0] = tok
-            self._pos[slot, 0] = req.prompt_len + len(toks) - 1
-            self._active[slot] = True
-            self._remaining[slot] = req.max_new_tokens - len(toks)
-            self._temp[slot] = req.temperature
-            self._top_k[slot] = req.top_k or 0
-            self._eos[slot] = -1 if req.eos_id is None else req.eos_id
-            self._keys[slot] = keys[r]
-            self._stepno[slot] = len(toks)
+        for r, req in enumerate(batched):
+            comp = self._activate(req, int(slots[r]), int(first[r]),
+                                  keys[r])
+            if comp is not None:
+                done.append(comp)
         self.prefills += 1
         return done
+
+    def prefill_chunk_step(self) -> List[Completion]:
+        """One chunk-program dispatch for the head of the chunk queue:
+        feed the next ``prefill_chunk`` tokens at the request's offset.
+        On the final chunk the sampled first token activates the decode
+        row (or retires the request, eos-on-first/budget-of-one), and —
+        prefix cache armed — the finished prompt's full pages are
+        published for future adopters."""
+        self.chunk_activated = None
+        if not self._chunk_queue:
+            return []
+        faults.fire("serve.dispatch")
+        st = self._chunk_queue[0]
+        req = st.request
+        C = self.prefill_chunk
+        L = len(st.fed)
+        off = st.next_off
+        valid = min(C, L - off)
+        final = off + valid >= L
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :valid] = st.fed[off:off + valid]
+        keys = np.asarray(
+            jax.random.fold_in(self._base_key, req.seed))[None]
+        temp = np.array([req.temperature], np.float32)
+        top_k = np.array([req.top_k or 0], np.int32)
+        startno = np.array([len(req.replay_tokens or ())], np.int32)
+        row_pages = np.array(self.pool.page_table[st.slot])
+        tel = self._tel
+        fn = _pick(_chunk_prefill_donated, _chunk_prefill_plain)
+        with (tel.span("engine.chunk", id=req.id, off=off, n=valid)
+              if tel is not None else NULL_SPAN):
+            self.pool.arena, first = fn(
+                self.model, self.params, self.pool.arena, row_pages,
+                tokens, np.int32(off), np.int32(valid), keys, temp,
+                top_k, startno)
+            first = np.asarray(first)
+        st.next_off = off + valid
+        self.chunk_dispatches += 1
+        if tel is not None:
+            tel.event("engine.chunk", id=req.id, off=off, n=valid,
+                      final=final)
+        if not final:
+            return []
+        self._chunk_queue.popleft()
+        if self.prefix is not None:
+            # publish before activation: eos-on-first retires the slot,
+            # but the cache's own refs keep the prefix pages warm
+            self.prefix.publish(list(req.prompt), st.slot)
+        comp = self._activate(req, st.slot, int(first[0]), keys[0])
+        if comp is None:
+            self.chunk_activated = req
+            return []
+        return [comp]
+
+    def _activate(self, req: Request, slot: int, tok: int,
+                  key: np.ndarray) -> Optional[Completion]:
+        """Shared first-token bookkeeping for the batched prefill and the
+        final chunk: record the token, retire on eos-on-first/exhausted
+        budget, otherwise arm the slot's decode row."""
+        toks = list(req.replay_tokens or ()) + [tok]
+        self._tokens[slot] = toks
+        self.tokens_generated += 1
+        hit_eos = req.eos_id is not None and tok == req.eos_id
+        if hit_eos or len(toks) >= req.max_new_tokens:
+            return self._retire(
+                slot, FINISH_EOS if hit_eos else FINISH_LENGTH)
+        self._cur[slot, 0] = tok
+        self._pos[slot, 0] = req.prompt_len + len(toks) - 1
+        self._active[slot] = True
+        self._remaining[slot] = req.max_new_tokens - len(toks)
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k or 0
+        self._eos[slot] = -1 if req.eos_id is None else req.eos_id
+        self._keys[slot] = key
+        self._stepno[slot] = len(toks)
+        return None
 
     def step(self) -> List[Completion]:
         """Advance every in-flight request up to ``steps_per_dispatch``
@@ -455,15 +946,29 @@ class ServeEngine:
             return []
         faults.fire("serve.dispatch")
         tel = self._tel
-        fn = _pick(_engine_step_donated, _engine_step_plain)
         with (tel.span("engine.step", active=int(self._active.sum()))
               if tel is not None else NULL_SPAN):
-            (self.pool.cache, cur, pos, active, remaining, stepno,
-             emitted, finished) = fn(
-                self.model, self.params, self.pool.cache, self._cur,
-                self._pos, self._active, self._remaining, self._temp,
-                self._top_k, self._eos, self._keys, self._stepno,
-                steps=self.steps_per_dispatch)
+            if self.paged:
+                fn = _pick(_paged_step_donated, _paged_step_plain)
+                # the table copy re-uploads H2D every dispatch though it
+                # only changes at admit/retire — known headroom for the
+                # pallas-kernel round (docs/performance.md), kept simple
+                # while the dispatch overhead dominates
+                (self.pool.arena, cur, pos, active, remaining, stepno,
+                 emitted, finished) = fn(
+                    self.model, self.params, self.pool.arena,
+                    np.array(self.pool.page_table), self._cur, self._pos,
+                    self._active, self._remaining, self._temp,
+                    self._top_k, self._eos, self._keys, self._stepno,
+                    steps=self.steps_per_dispatch)
+            else:
+                fn = _pick(_engine_step_donated, _engine_step_plain)
+                (self.pool.cache, cur, pos, active, remaining, stepno,
+                 emitted, finished) = fn(
+                    self.model, self.params, self.pool.cache, self._cur,
+                    self._pos, self._active, self._remaining, self._temp,
+                    self._top_k, self._eos, self._keys, self._stepno,
+                    steps=self.steps_per_dispatch)
         # np.array (copy): jax outputs view as read-only buffers, and the
         # next prefill writes these rows in place
         self._cur = np.array(cur)
@@ -497,10 +1002,17 @@ class ServeEngine:
     def snapshot_in_flight(self) -> List:
         """``[(request, tokens_emitted_so_far)]`` for every in-flight
         slot, in slot order — what a supervisor needs to re-admit this
-        engine's work after a crash (copies, never live buffers)."""
-        return [(self.pool.active[slot],
-                 list(self._tokens.get(slot, [])))
-                for slot in sorted(self.pool.active)]
+        engine's work after a crash (copies, never live buffers).
+        Mid-chunking prompts have no ``_tokens`` entry (decode hasn't
+        started — or, for a replay-of-a-replay, hasn't REstarted), so
+        they fall back to their ``replay_tokens``: a second crash during
+        a replay's chunk re-feed must not drop the first crash's
+        emissions."""
+        active = self.pool.active
+        return [(active[slot],
+                 list(self._tokens.get(
+                     slot, active[slot].replay_tokens or ())))
+                for slot in sorted(active)]
 
     def cancel(self, request_id: int,
                reason: str = FINISH_TIMEOUT) -> Optional[Completion]:
@@ -511,11 +1023,34 @@ class ServeEngine:
             return None
         return self._retire(slot, reason)
 
+    def shutdown(self) -> None:
+        """Release the engine's device state: drop prefix-cache refs and
+        the KV pool/arena so a retired engine stops pinning HBM. The
+        engine is unusable afterwards."""
+        if self.prefix is not None:
+            self.prefix.drop()
+        self.prefix = None
+        self.pool = None
+        self._chunk_queue.clear()
+        self._tokens.clear()
+        self._active[:] = False
+
     def _retire(self, slot: int, reason: str) -> Completion:
+        # only cancel() can retire a mid-chunking slot — don't rebuild
+        # the deque on every normal retirement while chunks stream
+        if any(st.slot == slot for st in self._chunk_queue):
+            self._chunk_queue = deque(
+                st for st in self._chunk_queue if st.slot != slot)
         req = self.pool.release(slot)
         self._active[slot] = False
-        tokens = self._tokens.pop(slot, [])
+        # a mid-chunking REPLAY has no _tokens entry yet: its pre-crash
+        # emissions live in replay_tokens and a cancel/deadline must
+        # still surface them (PR 3's partial-tokens contract)
+        tokens = self._tokens.pop(slot, None)
+        if tokens is None:
+            tokens = list(req.replay_tokens or ())
         return Completion(
             request_id=req.id, prompt=list(req.prompt), tokens=tokens,
             finish_reason=reason, arrival_time=req.arrival_time,
-            first_token_time=req.first_token_time)
+            first_token_time=req.first_token_time,
+            prefix_hit_tokens=req.prefix_hit_tokens)

@@ -25,6 +25,23 @@ FINISH_REJECTED = "rejected"  # shed at admission (trace replay only)
 FINISH_FAILED = "failed"      # engine crash recovery exhausted its retries
 
 
+class OccupancyError(RuntimeError):
+    """Base for admission-control errors carrying occupancy context.
+
+    Keyword context renders as a ``[k=v, ...]`` suffix on the message
+    (None values omitted) and every key becomes an attribute, so
+    shed-load callers can log actionable rejections instead of a bare
+    "full" (:class:`~ray_lightning_tpu.serve.pages.SlotPoolFull`,
+    :class:`~ray_lightning_tpu.serve.scheduler.QueueFull`)."""
+
+    def __init__(self, message: str, **ctx):
+        shown = [f"{k}={v}" for k, v in ctx.items() if v is not None]
+        super().__init__(
+            message + (f" [{', '.join(shown)}]" if shown else ""))
+        for k, v in ctx.items():
+            setattr(self, k, v)
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request.
@@ -57,6 +74,10 @@ class Request:
     # key stream at step len(replay_tokens) — replay-exact, see
     # docs/reliability.md.
     replay_tokens: Optional[List[int]] = None
+    # stamped by a paged engine at admission: how many prompt tokens'
+    # KV was adopted from the shared-prefix cache instead of computed
+    # (0 = no hit / dense engine); surfaced on the Completion
+    prefix_hit_tokens: int = 0
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]
@@ -88,6 +109,9 @@ class Completion:
     arrival_time: Optional[float] = None
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    # prompt tokens served from the shared-prefix KV cache (paged
+    # engines with prefix_cache=True; 0 otherwise)
+    prefix_hit_tokens: int = 0
 
     @property
     def latency(self) -> Optional[float]:

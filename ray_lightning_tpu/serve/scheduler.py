@@ -1,11 +1,12 @@
-"""FIFO admission + prefill/decode interleaving policy.
+"""FIFO admission + prefill/decode/chunk interleaving policy.
 
 The scheduler owns the *waiting* side of the engine: a bounded FIFO queue
 (admission control — a full queue rejects at submit time, it never grows
 unboundedly under overload), per-request deadlines (expired requests are
-dropped before they ever touch the accelerator), and the one real policy
-decision of continuous batching: **when to spend a step on prefill instead
-of decode**.
+dropped before they ever touch the accelerator), and the real policy
+decisions of continuous batching: **when to spend a step on prefill
+instead of decode**, and — chunked engines — **how to interleave a long
+prompt's chunk dispatches with in-flight decode**.
 
 A prefill pass stalls every in-flight decode for one program dispatch but
 fills free slots (raising decode utilization and cutting queue latency);
@@ -19,6 +20,18 @@ decoding first drains in-flight requests sooner but leaves slots idle.
   the prefill dispatch across more injected rows — highest decode
   throughput under sustained load.
 - values in between scale the batching threshold proportionally.
+
+Chunk interleaving is deliberately NOT a knob: while decode rows are
+active, chunk and decode dispatches strictly alternate, so an in-flight
+request's worst decode stall is ONE chunk-sized dispatch (that bound is
+the whole point of chunked prefill — ``decode_stall_p99_ms`` in the
+bench); with nothing decoding, chunks stream back-to-back.
+
+Admission is **page-aware** on paged engines: the scheduler pops only the
+queue-head prefix the engine can actually seat
+(``engine.admissible_prefix`` — slots, batched-program width, cumulative
+page demand against free + evictable pages), keeping FIFO order — a
+short request never jumps a long one that's next in line.
 """
 from __future__ import annotations
 
@@ -27,16 +40,29 @@ import math
 from collections import deque
 from typing import Deque, List, Optional, Tuple
 
-from ray_lightning_tpu.serve.request import Request
+from ray_lightning_tpu.serve.request import OccupancyError, Request
 
 # scheduler verdicts for the next engine dispatch
 ACTION_PREFILL = "prefill"
 ACTION_STEP = "step"
+ACTION_CHUNK = "chunk"
 ACTION_IDLE = "idle"
 
 
-class QueueFull(RuntimeError):
-    """Admission control: the waiting queue is at max_queue_depth."""
+class QueueFull(OccupancyError):
+    """Admission control: the waiting queue is at max_queue_depth.
+
+    Carries occupancy context for shed-load callers: ``queue_depth``
+    (the bound that was hit) and ``oldest_age`` (how long the head of
+    the queue has been waiting, in the driving client's clock units —
+    None when no clock/arrival data is available). An old head means the
+    server is drowning; a young one means a burst just landed.
+    """
+
+    def __init__(self, message: str, *, queue_depth: Optional[int] = None,
+                 oldest_age: Optional[float] = None):
+        super().__init__(message, queue_depth=queue_depth,
+                         oldest_age=oldest_age)
 
 
 @dataclasses.dataclass
@@ -62,11 +88,14 @@ class SchedulerConfig:
 
 
 class FifoScheduler:
-    """Bounded FIFO queue + the prefill/decode interleaving policy."""
+    """Bounded FIFO queue + the prefill/decode/chunk interleaving
+    policy."""
 
     def __init__(self, config: Optional[SchedulerConfig] = None):
         self.config = config or SchedulerConfig()
         self._queue: Deque[Request] = deque()
+        # chunk/decode alternation latch — see the module docstring
+        self._last_was_chunk = False
 
     def __len__(self) -> int:
         return len(self._queue)
@@ -80,8 +109,13 @@ class FifoScheduler:
         """Enqueue, or raise :class:`QueueFull` — overload sheds at the
         door instead of growing an unbounded backlog."""
         if len(self._queue) >= self.config.max_queue_depth:
+            head = self._queue[0]
+            oldest = (now - head.arrival_time
+                      if now is not None and head.arrival_time is not None
+                      else None)
             raise QueueFull(
-                f"queue at max_queue_depth={self.config.max_queue_depth}")
+                f"queue at max_queue_depth={self.config.max_queue_depth}",
+                queue_depth=len(self._queue), oldest_age=oldest)
         if (request.deadline is None
                 and self.config.default_deadline is not None
                 and now is not None):
@@ -91,7 +125,7 @@ class FifoScheduler:
     def requeue_front(self, requests: List[Request]) -> None:
         """Put popped-but-not-dispatched requests back at the queue head
         in their original order (e.g. a prefill deferred because its seed
-        collides with an in-flight request's sample stream)."""
+        collides with an in-flight request's seed)."""
         for req in reversed(requests):
             self._queue.appendleft(req)
 
@@ -110,25 +144,56 @@ class FifoScheduler:
         """Decide the next engine dispatch.
 
         Returns ``(ACTION_PREFILL, requests)`` with the requests POPPED
-        from the queue, ``(ACTION_STEP, [])`` to advance decode, or
+        from the queue, ``(ACTION_CHUNK, [])`` to advance the head
+        mid-chunking prompt, ``(ACTION_STEP, [])`` to advance decode, or
         ``(ACTION_IDLE, [])`` when there is nothing to do (the client
         waits for the next arrival).
         """
         free = engine.free_slots
+        chunks = getattr(engine, "chunk_pending", 0)
         if self._queue and free > 0:
-            k = min(len(self._queue), free, engine.prefill_batch)
-            if engine.active_count == 0:
-                return ACTION_PREFILL, self._pop(k)
-            # batching threshold: how many waiters justify stalling the
-            # in-flight decodes for one prefill dispatch
-            need = max(1, math.ceil(
-                (1.0 - self.config.prefill_priority)
-                * min(engine.prefill_batch, free)))
-            if len(self._queue) >= need:
-                return ACTION_PREFILL, self._pop(k)
+            k = min(len(self._queue), free)
+            probe = getattr(engine, "admissible_prefix", None)
+            if probe is not None:
+                # page-aware admission: only the head prefix that fits
+                # slots, pages AND the batched-program width (the probe
+                # owns the width rule — chunk-routed requests consume
+                # none of it, so pre-capping at prefill_batch here would
+                # needlessly throttle them). The probe's verdict over a
+                # FIFO prefix is prefix-stable, so feed it the head
+                # slice, not a copy of the whole queue.
+                k = min(k, probe([self._queue[i] for i in range(k)]))
+            else:
+                k = min(k, engine.prefill_batch)
+            if k > 0:
+                if engine.active_count == 0 and not chunks:
+                    return ACTION_PREFILL, self._pop(k)
+                # batching threshold: how many waiters justify stalling
+                # the in-flight decodes for one prefill dispatch
+                need = max(1, math.ceil(
+                    (1.0 - self.config.prefill_priority)
+                    * min(engine.prefill_batch, free)))
+                if len(self._queue) >= need:
+                    return ACTION_PREFILL, self._pop(k)
+        return self.drain_action(engine), []
+
+    def drain_action(self, engine) -> str:
+        """The chunk/decode half of the policy: strict alternation while
+        decode rows are active (the one-chunk stall bound), chunks
+        back-to-back otherwise. The client also calls this directly when
+        an admission tick dispatched nothing (every popped request
+        seed-deferred) — the substitute dispatch must honor the same
+        bound, or a persistent deferral would let chunks starve decode."""
+        if getattr(engine, "chunk_pending", 0):
+            if engine.active_count > 0 and self._last_was_chunk:
+                self._last_was_chunk = False
+                return ACTION_STEP
+            self._last_was_chunk = True
+            return ACTION_CHUNK
+        self._last_was_chunk = False
         if engine.active_count > 0:
-            return ACTION_STEP, []
-        return ACTION_IDLE, []
+            return ACTION_STEP
+        return ACTION_IDLE
 
     def _pop(self, k: int) -> List[Request]:
         return [self._queue.popleft() for _ in range(k)]

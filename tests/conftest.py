@@ -71,6 +71,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "ray_integration: requires a real ray install "
         "(auto-skipped otherwise; runs in the test-with-ray CI job)")
+    config.addinivalue_line(
+        "markers", "serve: the serving stack (engine/scheduler/paged KV/"
+        "prefill split) — `pytest -m serve` runs it as a fast targeted "
+        "subset")
 
 
 @pytest.fixture(autouse=True)

@@ -28,10 +28,13 @@ PKG = pathlib.Path(__file__).resolve().parent.parent / "ray_lightning_tpu"
 
 MARKER = "tl-lint: allow-leak"
 
-#: terminal callee names whose result owns OS/process-backed resources
+#: terminal callee names whose result owns OS/process-backed resources —
+#: or, for the serving pools (KVSlotPool dense cache, PagePool arena,
+#: PrefixCache page refs), device memory that must not outlive its engine
 RESOURCE_FACTORIES = {
     "_make_queue_channel", "make_queue", "Queue", "Manager",
     "GangMonitor", "StandbyPool", "MemoryCheckpointStore",
+    "KVSlotPool", "PagePool", "PrefixCache",
 }
 
 RELEASE_METHODS = {"shutdown", "close", "_kill", "kill"}

@@ -1,0 +1,669 @@
+"""Paged KV engine: page arena, prefix reuse, chunked prefill.
+
+The load-bearing assertion mirrors ``tests/test_serve.py``: greedy (and
+sampled) outputs must be **token-identical** to the dense static-slot
+engine — across page sizes, prefix-cache hits, chunked prefill, and
+crash-replay — because the paged programs run the exact same step body
+around a gather/scatter of the page arena (``docs/serving.md``). The
+rest pins the allocator itself: deterministic lowest-index-first page
+assignment, fragmentation-tolerant reuse, refcounted prefix-page
+release ordering, eviction-under-pressure determinism, and the
+occupancy-context errors shed-load callers log.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.models.generate import generate
+from ray_lightning_tpu.obs import Telemetry
+from ray_lightning_tpu.reliability import FaultPlan, RetryPolicy
+from ray_lightning_tpu.serve import (FINISH_FAILED, FINISH_LENGTH,
+                                     FINISH_REJECTED, PagePool, QueueFull,
+                                     Request, ServeClient, ServeEngine,
+                                     SlotPoolFull)
+
+pytestmark = pytest.mark.serve
+
+PAGED = dict(page_size=4, prefill_chunk=8, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+def _ref_windows(dec, params, prompts, n, eos_id=None):
+    """Per-request greedy reference from one-shot ragged generate()."""
+    P = max(len(p) for p in prompts)
+    batch = np.zeros((len(prompts), P), np.int32)
+    lengths = np.array([len(p) for p in prompts], np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, :len(p)] = p
+    out = np.asarray(generate(
+        dec, params, batch, max_new_tokens=n, rng=jax.random.PRNGKey(7),
+        temperature=0.0, prompt_lengths=lengths, eos_id=eos_id))
+    windows = []
+    for i, L in enumerate(lengths):
+        w = list(out[i, L:L + n])
+        if eos_id is not None and eos_id in w:
+            w = w[:w.index(eos_id) + 1]
+        windows.append([int(t) for t in w])
+    return windows
+
+
+PROMPTS = [[5, 17, 3, 9], [9, 2, 44], [42, 7], [1]]
+TRACE = [
+    (0, dict(prompt=PROMPTS[0], max_new_tokens=6)),
+    (0, dict(prompt=PROMPTS[1], max_new_tokens=6)),
+    (3, dict(prompt=PROMPTS[2], max_new_tokens=6)),
+    (5, dict(prompt=PROMPTS[3], max_new_tokens=6)),
+]
+
+
+# --------------------------------------------------------------------- #
+# token identity: paged == static == generate()
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+def test_paged_greedy_matches_static_engine(nano, page_size):
+    """The staggered mid-flight trace of test_serve, on the page arena:
+    every page size yields tokens identical to the dense engine (itself
+    pinned against generate())."""
+    dec, params = nano
+    static = ServeClient(dec, params, num_slots=3, prefill_len=8)
+    base = static.serve_trace(TRACE)
+    paged = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                        page_size=page_size)
+    out = paged.serve_trace(TRACE)
+    for rid in base:
+        assert out[rid].tokens == base[rid].tokens, (page_size, rid)
+        assert out[rid].finish_reason == base[rid].finish_reason
+    ref = _ref_windows(dec, params, PROMPTS, 6)
+    for rid in range(4):
+        assert out[rid].tokens == ref[rid]
+
+
+def test_paged_multistep_and_eos(nano):
+    """steps_per_dispatch>1 on the paged path stays a pure dispatch
+    amortization (same greedy tokens, eos rows retiring mid-block park
+    without corrupting their neighbours' pages)."""
+    dec, params = nano
+    free = _ref_windows(dec, params, PROMPTS, 6)
+    eos = free[0][2]
+    trace = [(t, dict(**kw, eos_id=eos)) for t, kw in TRACE]
+    ref = _ref_windows(dec, params, PROMPTS, 6, eos_id=eos)
+    out = ServeClient(dec, params, num_slots=2, prefill_len=8,
+                      page_size=4, steps_per_dispatch=4).serve_trace(trace)
+    for rid in range(4):
+        assert out[rid].tokens == ref[rid], (rid, out[rid].tokens, ref)
+
+
+def test_paged_page_reuse_overwrites_stale_kv(nano):
+    """Freed pages carry stale KV; a new tenant (batched inject — whole
+    mapped row overwritten) must decode exactly like a fresh engine."""
+    dec, params = nano
+    long_p, short_p = [5, 17, 3, 9, 2, 44, 1, 7], [42, 7]
+    out = ServeClient(dec, params, num_slots=1, prefill_len=8,
+                      page_size=4).serve_trace([
+                          (0, dict(prompt=long_p, max_new_tokens=4)),
+                          (1, dict(prompt=short_p, max_new_tokens=4)),
+                      ])
+    assert out[1].tokens == _ref_windows(dec, params, [short_p], 4)[0]
+
+
+# --------------------------------------------------------------------- #
+# chunked prefill
+# --------------------------------------------------------------------- #
+def test_chunked_prefill_interleaves_and_matches(nano):
+    """A 20-token prompt (> prefill_len) streams in chunk dispatches
+    interleaved 1:1 with decode: the short co-resident request keeps
+    decoding between chunks (stall bounded by ONE chunk — the event
+    stream pins step dispatches between chunk dispatches), and both
+    requests' outputs stay token-identical to generate()."""
+    dec, params = nano
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3, 4, 5, 6, 7, 8, 9, 1, 2]
+    short_p = PROMPTS[0]
+    ref = _ref_windows(dec, params, [long_p, short_p], 5)
+    tel = Telemetry()
+    client = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                         page_size=4, prefill_chunk=8, telemetry=tel)
+    out = client.serve_trace([
+        (0, dict(prompt=short_p, max_new_tokens=5)),
+        (2, dict(prompt=long_p, max_new_tokens=5)),
+    ])
+    assert out[0].tokens == ref[1]
+    assert out[1].tokens == ref[0]
+    assert client.engine.chunk_dispatches == 3  # ceil(20 / 8)
+    # interleave pinned: while the short request decodes, chunk
+    # dispatches alternate with step dispatches — no chunk ever follows
+    # another chunk while decode work exists
+    sites = [e.site for e in tel.events()
+             if e.site in ("engine.chunk", "engine.step")]
+    first_chunk = sites.index("engine.chunk")
+    between = sites[first_chunk:sites.index("engine.chunk",
+                                            first_chunk + 1)]
+    assert "engine.step" in between, sites
+
+
+def test_chunked_admits_prompts_beyond_prefill_len(nano):
+    """Chunking lifts the prompt <= prefill_len admission limit (only
+    prompt + budget <= max_seq_len remains); without it the same submit
+    is refused up front."""
+    dec, params = nano
+    long_p = list(range(1, 21))
+    plain = ServeClient(dec, params, num_slots=2, prefill_len=8)
+    with pytest.raises(ValueError, match="prefill_len"):
+        plain.submit(long_p, max_new_tokens=4)
+    chunked = ServeClient(dec, params, num_slots=2, prefill_len=8,
+                          page_size=4, prefill_chunk=8)
+    rid = chunked.submit(long_p, max_new_tokens=4)
+    out = chunked.run_until_idle()
+    assert out[rid].tokens == _ref_windows(dec, params, [long_p], 4)[0]
+    assert out[rid].finish_reason == FINISH_LENGTH
+    assert out[rid].time_to_first_token is not None
+
+
+def test_chunked_replay_token_identity(nano):
+    """PR 3's crash contract on the chunked path: dispatch crashes landing
+    BOTH mid-chunk-sequence and mid-decode rebuild + replay to the exact
+    fault-free tokens — including a replay whose prompt + emitted tokens
+    exceed prefill_len (unreplayable without chunking, pinned failed by
+    test_reliability; chunked replay streams it back in)."""
+    dec, params = nano
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3]   # 12 > prefill_len 8
+    trace = [
+        (0, dict(prompt=PROMPTS[0], max_new_tokens=6)),
+        (1, dict(prompt=long_p, max_new_tokens=8)),
+        (3, dict(prompt=PROMPTS[2], max_new_tokens=5, temperature=0.8,
+                 top_k=16, seed=91)),
+    ]
+    kw = dict(num_slots=3, prefill_len=8, page_size=4, prefill_chunk=8)
+    base = ServeClient(dec, params, **kw).serve_trace(trace)
+    # tick 1/2 land in the long prompt's chunk sequence; tick 7 lands
+    # mid-decode with prompt(12) + emitted > prefill_len(8)
+    for ticks in ([1], [2, 7]):
+        plan = FaultPlan.at("serve.dispatch", ticks)
+        client = ServeClient(dec, params, retry_policy=RetryPolicy(
+            max_attempts=3, base_delay=0.0), **kw)
+        with plan.armed():
+            out = client.serve_trace(trace)
+        assert plan.fired == len(ticks)
+        for rid in base:
+            assert out[rid].tokens == base[rid].tokens, (ticks, rid)
+            assert out[rid].finish_reason == base[rid].finish_reason
+        assert all(c.finish_reason != FINISH_FAILED for c in out.values())
+
+
+# --------------------------------------------------------------------- #
+# prefix cache
+# --------------------------------------------------------------------- #
+def test_prefix_cache_reuse_identity(nano):
+    """Requests sharing a system prompt adopt its KV pages instead of
+    re-prefilling — outputs stay token-identical to generate(), hits are
+    counted, and adoption is capped one token short of a whole prompt
+    (the final token's logits must be recomputed)."""
+    dec, params = nano
+    sysp = [11, 12, 13, 14, 15, 16, 17, 18]          # 2 pages @ ps=4
+    pa, pb = sysp + [5, 17, 3], sysp + [9, 2]
+    ref = _ref_windows(dec, params, [pa, pb, pa], 5)
+    client = ServeClient(dec, params, num_slots=3, prefill_len=8, **PAGED)
+    ra = client.submit(pa, max_new_tokens=5)
+    client.run_until_idle()
+    rb = client.submit(pb, max_new_tokens=5)
+    rc = client.submit(pa, max_new_tokens=5, seed=77)  # identical prompt
+    out = client.run_until_idle()
+    assert out[ra].tokens == ref[0]
+    assert out[rb].tokens == ref[1]
+    assert out[rc].tokens == ref[2] == ref[0]
+    assert out[ra].prefix_hit_tokens == 0
+    assert out[rb].prefix_hit_tokens == 8      # both sysp pages adopted
+    # identical 11-token prompt: usable pages (11-1)//4 = 2, and the
+    # chunk-multiple cap keeps it at 2 pages — tokens 8..10 recomputed
+    assert out[rc].prefix_hit_tokens == 8
+    assert client.engine.prefix.hits == 4
+    assert client.engine.prefix.hit_rate > 0
+
+
+def test_prefix_release_ordering(nano):
+    """Refcount ordering around retirement: (1) a retired publisher's
+    prefix pages stay warm (the cache holds them); (2) eviction skips
+    pages a live adopter holds; (3) once the adopter retires, the same
+    eviction frees them. Page accounting is exact at each stage."""
+    dec, params = nano
+    sysp = [11, 12, 13, 14, 15, 16, 17, 18]
+    eng = ServeEngine(dec, params, num_slots=3, prefill_len=8,
+                      num_pages=8, **PAGED)
+    pool, cache = eng.pool, eng.prefix
+
+    def run_admission(req):
+        eng.prefill([req])
+        while eng.chunk_pending:
+            eng.prefill_chunk_step()
+
+    # publisher: 8 prompt + 4 budget = 12 tokens -> 3 pages, 2 published
+    a = Request(id=0, prompt=sysp, max_new_tokens=4)
+    run_admission(a)
+    while eng.active_count:
+        eng.step()
+    assert len(cache) == 2 and pool.free_pages == 8 - 2  # pages warm
+    # adopter joins: needs ceil((9+4)/4)=4 pages, adopts 2, takes 2 fresh
+    b = Request(id=1, prompt=sysp + [9], max_new_tokens=4, seed=5)
+    run_admission(b)
+    assert pool.free_pages == 8 - 4
+    # eviction under a live adopter: both cached pages are refcount 2
+    assert cache.evictable() == 0
+    assert cache.evict(10) == 0 and len(cache) == 2
+    while eng.active_count:
+        eng.step()
+    # adopter retired: cache is the last holder, eviction frees them
+    assert cache.evictable() == 2
+    assert cache.evict(10) == 2
+    assert len(cache) == 0 and pool.free_pages == 8
+
+
+def test_eviction_under_pressure_determinism(nano):
+    """Pages evict least-recently-MATCHED first, and the whole
+    admit/retire/evict sequence is reproducible run-for-run (identical
+    page tables, eviction counts, and outputs)."""
+    dec, params = nano
+    pre_a = [11, 12, 13, 14, 15, 16, 17, 18]
+    pre_b = [21, 22, 23, 24, 25, 26, 27, 28]
+
+    def scenario():
+        eng = ServeEngine(dec, params, num_slots=3, prefill_len=8,
+                          num_pages=8, **PAGED)
+
+        def run(req):
+            eng.prefill([req])
+            while eng.chunk_pending:
+                eng.prefill_chunk_step()
+            while eng.active_count:
+                eng.step()
+
+        run(Request(id=0, prompt=pre_a, max_new_tokens=4))         # 2 cached
+        run(Request(id=1, prompt=pre_b, max_new_tokens=4, seed=3))  # 4 cached
+        # touch chain A (a hit re-MRUs it); cache now holds 4 pages
+        run(Request(id=2, prompt=pre_a + [5], max_new_tokens=4, seed=7))
+        assert eng.prefix.evictable() == 4 and eng.pool.free_pages == 4
+        # 6-page demand forces 2 evictions: B's chain is LRU, it pays
+        big = Request(id=3, prompt=list(range(40, 60)), max_new_tokens=4,
+                      seed=9)
+        run(big)
+        return (eng.prefix.evictions, sorted(eng.pool._free_pages),
+                [tuple(k) for k in eng.prefix._entries],
+                np.array(eng.pool.page_table))
+
+    ev1, free1, keys1, pt1 = scenario()
+    ev2, free2, keys2, pt2 = scenario()
+    assert ev1 == ev2 == 2
+    assert free1 == free2 and keys1 == keys2
+    assert np.array_equal(pt1, pt2)
+    # LRU order: the untouched chain (pre_b) was evicted, A survived
+    # (entries are chain-keyed: (parent_entry_id, page_tokens))
+    assert not any(k[1] == tuple(pre_b[:4]) for k in keys1)
+    assert any(k[1] == tuple(pre_a[:4]) for k in keys1)
+
+
+# --------------------------------------------------------------------- #
+# allocator: fragmentation, capacity, occupancy-context errors
+# --------------------------------------------------------------------- #
+def test_page_fragmentation_interleaved_retire_admit(nano):
+    """Interleaved retire/admit fragments the free list; a request whose
+    pages land non-contiguously (the page table is an arbitrary gather
+    index) still decodes token-identically, and page assignment stays
+    lowest-index-first deterministic."""
+    dec, params = nano
+    eng = ServeEngine(dec, params, num_slots=3, prefill_len=8,
+                      page_size=8, num_pages=4)
+    # 3 tenants: A=[0], B=[1,2], C=[3] (8-token and 16-token footprints)
+    a = Request(id=0, prompt=[5, 17], max_new_tokens=4)
+    b = Request(id=1, prompt=[9, 2], max_new_tokens=12, seed=4)
+    c = Request(id=2, prompt=[42, 7], max_new_tokens=4, seed=8)
+    eng.prefill([a, b, c])
+    assert [int(p) for p in eng.pool.page_table[0][:1]] == [0]
+    assert [int(p) for p in eng.pool.page_table[1][:2]] == [1, 2]
+    assert [int(p) for p in eng.pool.page_table[2][:1]] == [3]
+    eng.cancel(0)
+    eng.cancel(2)
+    assert eng.pool.free_pages == 2 and sorted(
+        eng.pool._free_pages) == [0, 3]
+    # D needs 2 pages -> gets the non-contiguous [0, 3]
+    d = Request(id=3, prompt=[1, 2, 3], max_new_tokens=12, seed=12)
+    done = eng.prefill([d])
+    slot_d = eng.pool.slot_of(3)
+    assert [int(p) for p in eng.pool.page_table[slot_d][:2]] == [0, 3]
+    toks = [t for comp in done if comp.request_id == 3
+            for t in comp.tokens]
+    while eng.pool.slot_of(3) is not None:
+        for comp in eng.step():
+            if comp.request_id == 3:
+                toks = comp.tokens
+    assert toks == _ref_windows(dec, params, [[1, 2, 3]], 12)[0]
+
+
+def test_paged_capacity_beyond_static_slots(nano):
+    """The decoupling the arena buys: at the SAME KV byte budget, mixed
+    short requests co-reside far beyond the static slot count (allocator
+    accounting only — the arena is built lazily, so this never touches
+    device memory)."""
+    dec, _ = nano
+    # static equivalent: 2 slots x max_seq_len(32) = 64 tokens of KV
+    pool = PagePool(dec, num_slots=16, page_size=4, num_pages=16)
+    admitted = 0
+    for i in range(16):
+        try:
+            pool.acquire(Request(id=i, prompt=[1, 2, 3], max_new_tokens=5,
+                                 seed=i))   # 8 tokens -> 2 pages
+            admitted += 1
+        except SlotPoolFull:
+            break
+    assert admitted == 8          # vs 2 static slots: 4x at this mix
+    # and the rejection carries occupancy context
+    with pytest.raises(SlotPoolFull) as exc:
+        pool.acquire(Request(id=99, prompt=[1, 2, 3], max_new_tokens=5,
+                             seed=99))
+    assert exc.value.pages_free == 0
+    assert exc.value.pages_needed == 2
+    assert exc.value.slots_free == 8
+    assert exc.value.active == 8
+    assert "pages_free=0" in str(exc.value)
+
+
+def test_admissible_prefix_is_fifo_and_page_aware(nano):
+    """The scheduler probe: admission stops at the first queue-head
+    request that doesn't fit (no skip-ahead), counting cumulative page
+    demand, slots, and the batched program width."""
+    dec, params = nano
+    eng = ServeEngine(dec, params, num_slots=4, prefill_len=8,
+                      page_size=8, num_pages=4)
+    small = lambda i: Request(id=i, prompt=[1], max_new_tokens=4, seed=i)
+    big = Request(id=50, prompt=[1, 2], max_new_tokens=22, seed=50)
+    # big needs 3 pages: [small(1pg), big(3pg), small] -> only the first
+    # two fit the 4-page arena; FIFO means the trailing small must wait
+    assert eng.admissible_prefix([small(0), big, small(1)]) == 2
+    # [big first] with one page short: nothing admits, nobody skips it
+    eng2 = ServeEngine(dec, params, num_slots=4, prefill_len=8,
+                       page_size=8, num_pages=2)
+    assert eng2.admissible_prefix([big, small(1)]) == 0
+    # dense engines: plain min(slots, prefill_batch, len)
+    eng3 = ServeEngine(dec, params, num_slots=2, prefill_len=8)
+    assert eng3.admissible_prefix([small(0), small(1), small(2)]) == 2
+
+
+def test_validate_rejects_arena_overflow_and_trace_sheds(nano):
+    """A request that can NEVER fit the arena is refused at submit (and
+    shed, not fatal, in a trace replay)."""
+    dec, params = nano
+    client = ServeClient(dec, params, num_slots=2, prefill_len=8,
+                         page_size=8, num_pages=2)  # 16-token arena
+    with pytest.raises(ValueError, match="never"):
+        client.submit([1, 2, 3, 4], max_new_tokens=20)
+    out = client.serve_trace([
+        (0, dict(prompt=[5, 17], max_new_tokens=4)),
+        (0, dict(prompt=[1, 2, 3, 4], max_new_tokens=20)),
+    ])
+    assert out[0].finish_reason == FINISH_LENGTH
+    assert out[1].finish_reason == FINISH_REJECTED
+
+
+def test_queuefull_carries_occupancy_context(nano):
+    """QueueFull tells shed-load callers how deep the queue is and how
+    long its head has been waiting."""
+    dec, params = nano
+    from ray_lightning_tpu.serve import SchedulerConfig
+    client = ServeClient(dec, params, num_slots=1, prefill_len=8,
+                         scheduler_config=SchedulerConfig(
+                             max_queue_depth=1))
+    client.submit([5, 17], max_new_tokens=8)   # occupies the one slot...
+    client.tick()
+    client.submit([9], max_new_tokens=2)       # ...so this one queues
+    client.tick()
+    with pytest.raises(QueueFull) as exc:
+        client.submit([3], max_new_tokens=2)
+    assert exc.value.queue_depth == 1
+    assert exc.value.oldest_age is not None and exc.value.oldest_age >= 0
+    assert "queue_depth=1" in str(exc.value)
+
+
+# --------------------------------------------------------------------- #
+# satellites: config clamp telemetry
+# --------------------------------------------------------------------- #
+def test_prefill_batch_clamp_warns_and_emits(nano):
+    """The silent min() clamp now announces itself: UserWarning + an
+    engine.config_clamped event naming requested vs effective."""
+    dec, params = nano
+    tel = Telemetry()
+    with pytest.warns(UserWarning, match="clamped"):
+        eng = ServeEngine(dec, params, num_slots=2, prefill_len=8,
+                          prefill_batch=16, telemetry=tel)
+    assert eng.prefill_batch == 2
+    evs = tel.events("engine.config_clamped")
+    assert len(evs) == 1
+    assert evs[0].payload == {"field": "prefill_batch", "requested": 16,
+                              "effective": 2}
+    # in-range values stay silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ServeEngine(dec, params, num_slots=2, prefill_len=8,
+                    prefill_batch=2)
+        ServeEngine(dec, params, num_slots=2, prefill_len=8)
+    # below-range refuses instead of silently promoting 0 to num_slots
+    with pytest.raises(ValueError, match="prefill_batch"):
+        ServeEngine(dec, params, num_slots=2, prefill_len=8,
+                    prefill_batch=0)
+
+
+# --------------------------------------------------------------------- #
+# post-review regressions: deferral livelock, replay under sharing
+# --------------------------------------------------------------------- #
+def test_seed_collision_defer_clears_during_chunk_prefill(nano):
+    """A queued request whose seed collides with a request still CHUNK-
+    PREFILLING (slot held, nothing decoding yet) must defer without
+    wedging the loop: the tick that admits nothing advances the chunk
+    queue instead, the conflict retires, and the deferred request
+    completes. (Regression: that tick used to dispatch nothing, so the
+    chunk queue never advanced and the deferral re-popped forever.)"""
+    dec, params = nano
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3]    # chunk-routed (> 8)
+    short_p = [42, 7]
+    ref = _ref_windows(dec, params, [long_p, short_p], 4)
+    client = ServeClient(dec, params, num_slots=4, prefill_len=8,
+                         page_size=4, prefill_chunk=8)
+    ra = client.submit(long_p, max_new_tokens=4, seed=7)
+    rb = client.submit(short_p, max_new_tokens=4, seed=7)   # collides
+    out = client.run_until_idle()
+    assert out[ra].tokens == ref[0]
+    assert out[rb].tokens == ref[1]
+    assert out[ra].finish_reason == out[rb].finish_reason == FINISH_LENGTH
+
+
+def test_double_crash_mid_replay_chunk_token_identity(nano):
+    """Sampled outputs stay token-identical when a SECOND dispatch crash
+    lands while the first crash's replay is still streaming its chunk
+    re-feed (replay-of-a-replay): whichever snapshot the second recovery
+    sees — mid-chunking or re-activated — the final stream must match
+    the fault-free run."""
+    dec, params = nano
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3]    # 12 > prefill_len 8
+    # sampled: an erased replay restarts the key stream at step 0 and
+    # the token stream diverges (greedy would mask the loss)
+    trace = [(0, dict(prompt=long_p, max_new_tokens=8, temperature=0.8,
+                      top_k=32, seed=13))]
+    kw = dict(num_slots=2, prefill_len=8, page_size=4, prefill_chunk=8)
+    base = ServeClient(dec, params, **kw).serve_trace(trace)
+    # first fault mid-decode (tokens emitted, prompt + emitted > chunk →
+    # replay routes chunked), second during the replay's chunk re-feed
+    for second in (5, 6, 7):
+        plan = FaultPlan.at("serve.dispatch", [4, second])
+        client = ServeClient(dec, params, retry_policy=RetryPolicy(
+            max_attempts=3, base_delay=0.0), **kw)
+        with plan.armed():
+            out = client.serve_trace(trace)
+        assert plan.fired == 2, second
+        assert out[0].tokens == base[0].tokens, second
+        assert out[0].finish_reason == base[0].finish_reason
+
+
+def test_cancel_mid_replay_chunk_keeps_precrash_tokens(nano):
+    """PR 3's partial-tokens contract survives a cancel landing while a
+    crashed request's replay is still streaming its chunk re-feed:
+    mid-chunking slots snapshot AND retire with their pre-crash
+    ``replay_tokens`` — decode hasn't restarted, so ``_tokens`` has no
+    entry for them. (Regression: snapshot_in_flight and _retire both
+    reported zero tokens for mid-chunking replays, so a deadline expiry
+    or second crash in that window silently dropped every
+    already-emitted token.)"""
+    dec, params = nano
+    from ray_lightning_tpu.reliability import ServeSupervisor
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3]    # 12 > prefill_len 8
+    kw = dict(num_slots=2, prefill_len=8, page_size=4, prefill_chunk=8)
+    sup = ServeSupervisor(dec, params, policy=RetryPolicy(
+        max_attempts=3, base_delay=0.0), **kw)
+    sup.prefill([Request(id=0, prompt=long_p, max_new_tokens=8)])
+    while sup.chunk_pending:
+        sup.prefill_chunk_step()
+    for _ in range(3):
+        sup.step()
+    slot = sup.engine.pool.slot_of(0)
+    pre = list(sup.engine._tokens[slot])              # 1 + 3 = 4 tokens
+    assert len(pre) == 4
+    plan = FaultPlan.at("serve.dispatch", [0])
+    with plan.armed():
+        sup.step()            # crash -> rebuild; replay routes chunked
+    assert plan.fired == 1    # (prompt 12 + 4 emitted > prefill_len)
+    assert sup.chunk_pending
+    # snapshot taken NOW (second crash / shutdown) must carry them too
+    assert [toks for _r, toks in sup.engine.snapshot_in_flight()] == [pre]
+    comp = sup.cancel(0)
+    assert comp.tokens == pre
+
+
+def test_recovery_drained_chunk_ttft_not_end_to_end(nano):
+    """A fresh request whose chunk prefill is drained INSIDE prefix-
+    replay recovery still gets a real TTFT: the client stamps activation
+    right after the recovering dispatch (rebuilds advanced), instead of
+    the retire-time fallback silently equating TTFT with end-to-end
+    latency."""
+    dec, params = nano
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3]    # 12 > chunk 8
+    trace = [(0, dict(prompt=long_p, max_new_tokens=5))]
+    kw = dict(num_slots=2, prefill_len=8, **PAGED)
+    base = ServeClient(dec, params, **kw).serve_trace(trace)
+    plan = FaultPlan.at("serve.dispatch", [1])       # mid-chunk crash
+    client = ServeClient(dec, params, retry_policy=RetryPolicy(
+        max_attempts=3, base_delay=0.0), **kw)
+    with plan.armed():
+        out = client.serve_trace(trace)
+    assert plan.fired == 1
+    assert out[0].tokens == base[0].tokens
+    assert out[0].time_to_first_token is not None
+    assert out[0].time_to_first_token < out[0].latency
+
+
+def test_requeued_chunk_replay_ttft_stamps_at_activation(nano):
+    """The post-recovery TTFT sweep must SKIP requests the recovery
+    re-queued mid-chunk (non-prefix replay leaves their chunk re-feed to
+    the client loop): their first token arrives chunk dispatches later,
+    and the decode span (finish − first_token) must match the fault-free
+    run. (Regression: the sweep stamped them at the recovery tick, so
+    TTFT was under-reported and TPOT inflated by the chunk re-feed.)"""
+    dec, params = nano
+    long_p = [7, 1, 9, 3, 5, 2, 8, 4, 6, 1, 2, 3]    # 12 > chunk 8
+    trace = [(0, dict(prompt=long_p, max_new_tokens=5))]
+    kw = dict(num_slots=2, prefill_len=8, page_size=4, prefill_chunk=8)
+    base = ServeClient(dec, params, **kw).serve_trace(trace)
+    span = base[0].finish_time - base[0].first_token_time
+    plan = FaultPlan.at("serve.dispatch", [1])       # mid-chunk crash
+    client = ServeClient(dec, params, retry_policy=RetryPolicy(
+        max_attempts=3, base_delay=0.0), **kw)
+    with plan.armed():
+        out = client.serve_trace(trace)
+    assert plan.fired == 1
+    assert out[0].tokens == base[0].tokens
+    assert out[0].finish_time - out[0].first_token_time == span
+
+
+def test_seed_deferral_keeps_chunk_decode_alternation(nano):
+    """A persistently deferred request (seed collision with an ACTIVE
+    decoder) must not let a co-resident long prompt's chunks stream
+    back-to-back: the substitute dispatch honors the same chunk/decode
+    alternation as the scheduler, keeping the decoder's worst stall at
+    ONE chunk. (Regression: the deferral branch dispatched chunks
+    unconditionally — the whole remaining prompt streamed in consecutive
+    chunk dispatches, exactly the monolithic stall chunking exists to
+    bound.)"""
+    dec, params = nano
+    long_p = list(range(1, 25))                       # 3 chunks @ C=8
+    ref = _ref_windows(dec, params, [PROMPTS[0], long_p, PROMPTS[2]], 8)
+    tel = Telemetry()
+    client = ServeClient(dec, params, num_slots=4, prefill_len=8,
+                         page_size=4, prefill_chunk=8, telemetry=tel)
+    ra = client.submit(PROMPTS[0], max_new_tokens=8, seed=7)
+    client.tick()                                     # A active, decoding
+    rb = client.submit(long_p, max_new_tokens=8, seed=1)
+    rc = client.submit(PROMPTS[2], max_new_tokens=8, seed=7)  # collides
+    out = client.run_until_idle()
+    assert out[ra].tokens == ref[0]
+    assert out[rb].tokens == ref[1]
+    assert out[rc].tokens == ref[2]
+    # A stayed active through B's whole chunk sequence, so no two chunk
+    # dispatches may ever run back-to-back
+    sites = [e.site for e in tel.events()
+             if e.site in ("engine.chunk", "engine.step")]
+    for prev, cur in zip(sites, sites[1:]):
+        assert not (prev == cur == "engine.chunk"), sites
+
+
+def test_replay_rebuilds_prefix_sharing_on_undercommitted_arena(nano):
+    """Crash recovery on an arena its tenants only fit via SHARED prefix
+    pages: replay re-seats one request per wave, draining its chunk
+    prefill before the next admits, so each completed replay republishes
+    its prefix pages and the next wave adopts them — exactly the dead
+    engine's co-residency, token-identical. (Regression: batch replay
+    demanded every request's FULL page count against the fresh engine's
+    empty cache and deterministically exhausted retries, failing the
+    whole snapshot — including requests that fit individually.)"""
+    dec, params = nano
+    sysp = [11, 12, 13, 14, 15, 16, 17, 18]           # 2 pages @ ps=4
+    pa, pb = sysp + [5, 17, 3], sysp + [9, 2]
+    ref = _ref_windows(dec, params, [pa, pb], 5)
+    # 6-page arena: a needs 4; b needs 4 but adopts a's 2 published
+    # prefix pages -> 2 fresh. Unshared the pair needs 8 — doesn't fit.
+    kw = dict(num_slots=3, prefill_len=8, num_pages=6, **PAGED)
+    from ray_lightning_tpu.reliability import ServeSupervisor
+
+    def drive(sup):
+        done = []
+        done += sup.prefill([Request(id=0, prompt=pa, max_new_tokens=5)])
+        while sup.chunk_pending:
+            done += sup.prefill_chunk_step()
+        # a is decoding and published sysp; b adopts -> 6 pages total
+        done += sup.prefill([Request(id=1, prompt=pb, max_new_tokens=5,
+                                     seed=5)])
+        while sup.chunk_pending:
+            done += sup.prefill_chunk_step()
+        while sup.active_count:
+            done += sup.step()
+        return {c.request_id: c for c in done}
+
+    base = drive(ServeSupervisor(dec, params, **kw))
+    # dispatches 1-5 are admissions + chunks; 6 is the first decode step
+    # with BOTH requests live on the shared pages
+    plan = FaultPlan.at("serve.dispatch", [6])
+    sup = ServeSupervisor(dec, params, policy=RetryPolicy(
+        max_attempts=3, base_delay=0.0), **kw)
+    with plan.armed():
+        out = drive(sup)
+    assert plan.fired == 1
+    assert sup.rebuilds == 1 and sup.failed_requests == 0
+    for rid in (0, 1):
+        assert out[rid].tokens == base[rid].tokens == ref[rid], rid
+        assert out[rid].finish_reason == FINISH_LENGTH

@@ -17,6 +17,8 @@ from ray_lightning_tpu.models import TransformerLM, gpt2_config
 from ray_lightning_tpu.models.generate import (generate, generate_full_scan,
                                                prefill)
 
+pytestmark = pytest.mark.serve
+
 
 def _nano(scan_layers, **over):
     mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,

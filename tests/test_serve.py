@@ -24,6 +24,8 @@ from ray_lightning_tpu.serve import (FINISH_EOS, FINISH_LENGTH,
 from ray_lightning_tpu.serve.scheduler import (ACTION_PREFILL, ACTION_STEP,
                                                FifoScheduler)
 
+pytestmark = pytest.mark.serve
+
 
 @pytest.fixture(scope="module")
 def nano():
