@@ -1256,6 +1256,134 @@ def _bench_chaos(num_slots: int = 4, n_requests: int = 8,
     }
 
 
+def _bench_fleet(num_replicas: int = 3, n_requests: int = 12,
+                 prompt: int = 32, new_tokens: int = 32,
+                 steps_per_dispatch: int = 4) -> dict:
+    """Replica-fleet serving under a seeded replica kill (ROADMAP item 2).
+
+    A ``num_replicas`` :class:`ReplicaFleet` (GPT-2-small, **fp32**
+    serving params — failover replay must be checkable token-for-token,
+    and bf16 greedy margins on untrained weights sit below rounding,
+    see ``_bench_chaos``) serves the same pinned staggered trace three
+    ways: one clean fleet pass, one with a pinned
+    ``FaultPlan.at("serve.replica", ...)`` killing a replica mid-run
+    (its in-flight requests re-admit to survivors via replay, a warm
+    standby is promoted), and one single-engine :class:`ServeClient`
+    with the fleet's total slot count for the scaling reference.
+
+    ``extras["fleet"]`` (untracked — failover cost is dominated by
+    engine construction/compile behavior, recorded for trend
+    visibility): ``fleet_tokens_per_sec`` (under the kill) /
+    ``fleet_clean_tokens_per_sec`` / ``single_engine_tokens_per_sec``
+    and their ratio, ``fleet_failover_ms`` (snapshot + teardown +
+    replay re-admission + standby promotion, from the fleet's own
+    ``failover_s_total``), and ``readmitted_token_mismatches`` — which
+    MUST be 0 in fp32: a non-zero count means failover replay broke and
+    every other number here is meaningless (enforced)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.reliability import FaultPlan
+    from ray_lightning_tpu.serve import (FINISH_FAILED, ReplicaFleet,
+                                         ServeClient)
+
+    total = prompt + new_tokens
+    num_slots = 4  # per replica
+    base = dict(vocab_size=50304, max_seq_len=total, dtype=jnp.float32,
+                scan_layers=False)
+    model = TransformerLM(gpt2_config("small", **base))
+    toks0 = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50257, size=(num_slots, prompt)), jnp.int32)
+    params = jax.device_put(
+        model.init(jax.random.PRNGKey(0), toks0)["params"])
+    dec = TransformerLM(gpt2_config("small", decode=True, **base))
+
+    rng = np.random.default_rng(4)
+    trace = []
+    for i in range(n_requests):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        trace.append((0.02 * i, dict(
+            prompt=[int(t) for t in rng.integers(0, 50257, size=L)],
+            max_new_tokens=int(rng.integers(new_tokens // 2,
+                                            new_tokens + 1)))))
+
+    # prefill_len covers prompt + full budget: the replay window rule
+    # (docs/reliability.md) — a mid-decode victim re-feeds prompt +
+    # emitted through ONE prefill pass on its new replica
+    kw = dict(num_slots=num_slots, prefill_len=total,
+              steps_per_dispatch=steps_per_dispatch)
+
+    def run_fleet(plan=None):
+        fleet = ReplicaFleet(dec, params, num_replicas=num_replicas,
+                             num_standby=1, clock=time.perf_counter, **kw)
+        if plan is None:
+            out = fleet.serve_trace(trace)
+        else:
+            with plan.armed():
+                out = fleet.serve_trace(trace)
+        makespan = max(c.finish_time for c in out.values())
+        fleet.shutdown()
+        return fleet, out, makespan
+
+    run_fleet()  # warmup: compiles prefill+inject and the K-step program
+    _, clean_out, clean_makespan = run_fleet()
+
+    # the kill lands a few rounds in: with num_replicas live replicas
+    # firing per fleet tick, tick 3*num_replicas+1 is replica 1 on
+    # fleet round 3 — mid-run, slots occupied
+    plan = FaultPlan.at("serve.replica", [3 * num_replicas + 1])
+    fleet, out, makespan = run_fleet(plan)
+    if plan.fired != 1 or fleet.failovers != 1:
+        raise MeasurementError(
+            f"fault plan fired {plan.fired}, failovers "
+            f"{fleet.failovers} — the kill tick no longer lands inside "
+            "the run; retune _bench_fleet knobs")
+    mismatched = sum(1 for rid, comp in clean_out.items()
+                     if out[rid].tokens != comp.tokens)
+    failed = sum(1 for c in out.values()
+                 if c.finish_reason == FINISH_FAILED)
+    if failed or mismatched:
+        raise MeasurementError(
+            f"fleet failover lost work ({failed} failed, {mismatched}/"
+            f"{n_requests} diverged in fp32) — replay is broken, timing "
+            "numbers would be meaningless")
+
+    def run_single():
+        client = ServeClient(dec, params, clock=time.perf_counter,
+                             **{**kw, "num_slots":
+                                num_slots * num_replicas})
+        single_out = client.serve_trace(trace)
+        makespan = max(c.finish_time for c in single_out.values())
+        client.shutdown()
+        return makespan
+
+    # the 12-slot shapes compile fresh (the fleet warmup only built the
+    # per-replica 4-slot programs): warm this leg too or its makespan
+    # eats the XLA compile and flatters the fleet ratio
+    run_single()
+    single_makespan = run_single()
+
+    tokens_total = sum(len(c.tokens) for c in out.values())
+    return {
+        "model": "gpt2_small (fp32 serving params)",
+        "replicas": num_replicas, "slots_per_replica": num_slots,
+        "requests": n_requests,
+        "steps_per_dispatch": steps_per_dispatch,
+        "fleet_tokens_per_sec": round(tokens_total / makespan, 0),
+        "fleet_clean_tokens_per_sec": round(
+            tokens_total / clean_makespan, 0),
+        "single_engine_tokens_per_sec": round(
+            tokens_total / single_makespan, 0),
+        "fleet_vs_single_engine": round(
+            single_makespan / clean_makespan, 2),
+        "fleet_failover_ms": round(1e3 * fleet.failover_s_total, 1),
+        "readmitted_requests": fleet.readmitted,
+        "readmitted_token_mismatches": mismatched,
+    }
+
+
 def _bench_gang() -> dict:
     """Gang kill-and-restart cost on the process backend: cold vs warm.
 
@@ -2000,6 +2128,18 @@ def main() -> None:
         extras["chaos"] = _bench_chaos()
     except Exception as exc:
         extras["chaos"] = {"error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # replica-fleet serving under a seeded serve.replica kill:
+        # failover cost + fleet-vs-single-engine throughput, untracked.
+        # This IS the fleet leg of the chaos bench (the kill is a
+        # pinned FaultPlan), so mirror the failover cost there too.
+        extras["fleet"] = _bench_fleet()
+        if isinstance(extras.get("chaos"), dict):
+            extras["chaos"]["fleet_failover_ms"] = \
+                extras["fleet"]["fleet_failover_ms"]
+    except Exception as exc:
+        extras["fleet"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     try:
         # gang kill-and-restart on the process backend, untracked
         if isinstance(extras.get("chaos"), dict):

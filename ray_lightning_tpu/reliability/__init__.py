@@ -71,13 +71,14 @@ def log_suppressed(site: str, exc: BaseException, detail: str = "") -> None:
 from ray_lightning_tpu.reliability.faults import (  # noqa: E402
     FaultPlan, FaultSpec, InjectedFault, MODE_EXIT, MODE_NAN, MODE_RAISE,
     MODE_STALL, SITE_CKPT_SAVE, SITE_LOADER_NEXT, SITE_RENDEZVOUS_INIT,
-    SITE_SERVE_DISPATCH, SITE_TRAIN_STEP, SITE_WORKER_EXIT,
-    SITE_WORKER_STALL, arm, disarm, ensure_armed, fire, get_armed)
+    SITE_SERVE_DISPATCH, SITE_SERVE_REPLICA, SITE_TRAIN_STEP,
+    SITE_WORKER_EXIT, SITE_WORKER_STALL, arm, disarm, ensure_armed, fire,
+    get_armed)
 from ray_lightning_tpu.reliability.guard import NonFiniteError  # noqa: E402
 from ray_lightning_tpu.reliability.retry import (  # noqa: E402
     RetriesExhausted, RetryPolicy, call_with_retry)
 from ray_lightning_tpu.reliability.supervisor import (  # noqa: E402
-    FitSupervisor, ServeSupervisor)
+    FitSupervisor, ServeSupervisor, failed_completion)
 from ray_lightning_tpu.reliability.gang import (  # noqa: E402
     GangConfig, GangFailure, GangMonitor, GangSupervisor, HeartbeatEmitter,
     RankPostmortem)
@@ -88,11 +89,11 @@ from ray_lightning_tpu.reliability.elastic import (  # noqa: E402
 __all__ = [
     "FaultPlan", "FaultSpec", "InjectedFault", "MODE_EXIT", "MODE_NAN",
     "MODE_RAISE", "MODE_STALL", "SITE_CKPT_SAVE", "SITE_LOADER_NEXT",
-    "SITE_RENDEZVOUS_INIT", "SITE_SERVE_DISPATCH", "SITE_TRAIN_STEP",
-    "SITE_WORKER_EXIT", "SITE_WORKER_STALL", "arm", "disarm",
-    "ensure_armed", "fire", "get_armed",
+    "SITE_RENDEZVOUS_INIT", "SITE_SERVE_DISPATCH", "SITE_SERVE_REPLICA",
+    "SITE_TRAIN_STEP", "SITE_WORKER_EXIT", "SITE_WORKER_STALL", "arm",
+    "disarm", "ensure_armed", "fire", "get_armed",
     "NonFiniteError", "RetriesExhausted", "RetryPolicy", "call_with_retry",
-    "FitSupervisor", "ServeSupervisor",
+    "FitSupervisor", "ServeSupervisor", "failed_completion",
     "GangConfig", "GangFailure", "GangMonitor", "GangSupervisor",
     "HeartbeatEmitter", "RankPostmortem",
     "MemoryCheckpointClient", "MemoryCheckpointStore", "StandbyPool",

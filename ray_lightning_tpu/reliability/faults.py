@@ -26,6 +26,13 @@ Sites are woven into the hot paths as a single ``fire(site)`` call:
                       gang watchdog's hang verdict)
 ``rendezvous.init``   driver-side, at the top of the launcher's
                       rendezvous brokering in ``setup_workers``
+``serve.replica``     per replica dispatch turn inside a
+                      :class:`~ray_lightning_tpu.serve.fleet.ReplicaFleet`
+                      tick — ``raise`` kills the whole replica (its
+                      in-flight work fails over to survivors),
+                      ``stall`` wedges its dispatch loop (heartbeats
+                      stop; the fleet's hang verdict). Carries the
+                      replica's stable id as ``rank``.
 ====================  ====================================================
 
 The worker sites additionally carry the firing worker's **rank**
@@ -66,6 +73,7 @@ SITE_LOADER_NEXT = "loader.next"
 SITE_WORKER_EXIT = "worker.exit"
 SITE_WORKER_STALL = "worker.stall"
 SITE_RENDEZVOUS_INIT = "rendezvous.init"
+SITE_SERVE_REPLICA = "serve.replica"
 
 MODE_RAISE = "raise"
 MODE_NAN = "nan"
@@ -85,6 +93,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     SITE_WORKER_EXIT: (MODE_EXIT, MODE_RAISE),
     SITE_WORKER_STALL: (MODE_STALL, MODE_RAISE),
     SITE_RENDEZVOUS_INIT: (MODE_RAISE, MODE_STALL),
+    SITE_SERVE_REPLICA: (MODE_RAISE, MODE_STALL),
 }
 
 

@@ -242,6 +242,20 @@ class GangMonitor:
         fast ranks finishing long before slow ones — is not a hang)."""
         self._done.add(rank)
 
+    def seed(self, rank: int, *, last_beat: float, last_step: int,
+             beats: int) -> None:
+        """Carry one rank's ledger entry in from a previous monitor
+        generation. The fleet rebuilds its monitor on every membership
+        change but a surviving member's silence clock must NOT reset
+        with it — churn recurring faster than ``heartbeat_timeout``
+        would otherwise defer a wedged member's hang verdict forever,
+        and postmortems taken right after a rebuild would report
+        freshly-stamped ages instead of real ones."""
+        if rank in self._last_beat:
+            self._last_beat[rank] = last_beat
+            self._last_step[rank] = last_step
+            self._beats[rank] = beats
+
     # ---------------------------------------------------------- verdicts
     def silent_ranks(self) -> List[int]:
         now = self._clock()

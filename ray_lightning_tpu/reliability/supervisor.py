@@ -36,9 +36,10 @@ from ray_lightning_tpu.serve.request import (Completion, FINISH_FAILED,
                                              Request)
 
 
-def _failed(req: Request, tokens) -> Completion:
+def failed_completion(req: Request, tokens) -> Completion:
     """The FINISH_FAILED retirement every recovery dead-end shares
-    (retries exhausted, unreplayable entry, shed replay wave): partial
+    (retries exhausted, unreplayable entry, shed replay wave, a fleet
+    failover with no surviving replica to take the request): partial
     tokens kept, timing carried over."""
     return Completion(
         request_id=req.id, prompt=list(req.prompt), tokens=list(tokens),
@@ -171,7 +172,8 @@ class ServeSupervisor:
                 ).inc()
             self.failed_requests += len(entries)
             self.recovery_s_total += time.perf_counter() - t0
-            return [_failed(req, toks) for req, toks in entries]
+            return [failed_completion(req, toks)
+                    for req, toks in entries]
 
     def _rebuild_and_replay(self, entries: List[Tuple[Request, List[int]]]
                             ) -> List[Completion]:
@@ -198,7 +200,7 @@ class ServeSupervisor:
                 # sequence axis with it (docs/reliability.md names the
                 # sizing rule); counted by _recover iff this attempt
                 # commits
-                done.append(_failed(req, toks))
+                done.append(failed_completion(req, toks))
                 continue
             req.replay_tokens = list(toks)
             pending.append(req)
@@ -226,7 +228,7 @@ class ServeSupervisor:
                 # a drained replay cannot reconstruct): shed THIS wave,
                 # keep replaying the rest instead of exhausting retries
                 # on a deterministic refusal
-                done.extend(_failed(req, req.replay_tokens or ())
+                done.extend(failed_completion(req, req.replay_tokens or ())
                             for req in wave)
                 continue
             while prefix_replay and self.engine.chunk_pending:

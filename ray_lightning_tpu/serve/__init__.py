@@ -13,6 +13,9 @@ See ``docs/serving.md`` for the architecture. Quick start::
 from ray_lightning_tpu.serve.client import ServeClient
 from ray_lightning_tpu.serve.engine import (KVSlotPool, ServeEngine,
                                             SlotPoolFull)
+from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetSaturated,
+                                           ReplicaFleet, Router,
+                                           RouterConfig)
 from ray_lightning_tpu.serve.pages import PagePool, PrefixCache
 from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_FAILED, FINISH_LENGTH,
@@ -24,6 +27,7 @@ from ray_lightning_tpu.serve.scheduler import (FifoScheduler, QueueFull,
 __all__ = [
     "ServeClient", "ServeEngine", "KVSlotPool", "PagePool", "PrefixCache",
     "SlotPoolFull", "Request", "Completion", "FifoScheduler", "QueueFull",
-    "SchedulerConfig", "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH",
-    "FINISH_REJECTED", "FINISH_TIMEOUT",
+    "SchedulerConfig", "ReplicaFleet", "Router", "RouterConfig",
+    "FleetConfig", "FleetSaturated", "FINISH_EOS", "FINISH_FAILED",
+    "FINISH_LENGTH", "FINISH_REJECTED", "FINISH_TIMEOUT",
 ]

@@ -45,6 +45,7 @@ class ServeClient:
                  scheduler_config: Optional[SchedulerConfig] = None,
                  seed: int = 0,
                  clock: Optional[Callable[[], float]] = None,
+                 clock_epoch: Optional[float] = None,
                  retry_policy=None, telemetry=None,
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
@@ -69,7 +70,11 @@ class ServeClient:
             self.engine = ServeEngine(model, params, **engine_kwargs)
         self.scheduler = FifoScheduler(scheduler_config)
         self._clock = clock
-        self._t0: Optional[float] = None
+        # clock_epoch pins t=0 to an external origin instead of this
+        # client's first now() call — how a ReplicaFleet keeps every
+        # replica (including ones promoted mid-run) on ONE shared
+        # timeline, so deadlines and TTFT stamps survive failover
+        self._t0: Optional[float] = clock_epoch
         self._ops = 0  # engine dispatches so far = the tick clock
         self._next_id = 0
         self._seen_rebuilds = 0  # supervised: recovery TTFT sweep
@@ -104,11 +109,23 @@ class ServeClient:
                       max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, eos_id=eos_id,
                       seed=seed, deadline=deadline)
+        rid = self.submit_request(req)
+        self._next_id += 1
+        return rid
+
+    def submit_request(self, req: Request) -> int:
+        """Validate + enqueue an externally built :class:`Request` — the
+        router seat: a :class:`~ray_lightning_tpu.serve.fleet.ReplicaFleet`
+        owns request ids fleet-wide and re-admits a dead replica's
+        requests here, so arrival/deadline/first-token stamps (and
+        ``replay_tokens``) must ride the request object untouched:
+        ``arrival_time`` is only stamped when the request has never been
+        admitted anywhere."""
         self.engine.validate(req)
         now = self.now()
         self.scheduler.submit(req, now)
-        req.arrival_time = now
-        self._next_id += 1
+        if req.arrival_time is None:
+            req.arrival_time = now
         tel = self._tel
         if tel is not None:
             tel.event("serve.submit", id=req.id,
@@ -143,12 +160,18 @@ class ServeClient:
         retired by this tick (including deadline expirations)."""
         now = self.now()
         done: List[Completion] = []
-        # queued requests past deadline never touch the accelerator
+        # queued requests past deadline never touch the accelerator — but
+        # a failover re-admission waiting here already streamed tokens on
+        # its dead replica (replay_tokens) and keeps them, plus its
+        # original first-token stamp (the PR 3 partial-tokens contract)
         for req in self.scheduler.expire(now):
             done.append(Completion(
-                request_id=req.id, prompt=list(req.prompt), tokens=[],
+                request_id=req.id, prompt=list(req.prompt),
+                tokens=list(req.replay_tokens or []),
                 finish_reason=FINISH_TIMEOUT,
-                arrival_time=req.arrival_time))
+                arrival_time=req.arrival_time,
+                first_token_time=req.first_token_time,
+                prefix_hit_tokens=req.prefix_hit_tokens))
         # in-flight requests past deadline free their slot mid-decode
         for req in list(self.engine.active_requests.values()):
             if req.deadline is not None and now >= req.deadline:
@@ -176,7 +199,33 @@ class ServeClient:
                     for req in admit:
                         tel.event("serve.admit", id=req.id,
                                   queue_wait=now - req.arrival_time)
-                done.extend(self.engine.prefill(admit))
+                try:
+                    done.extend(self.engine.prefill(admit))
+                except Exception:
+                    # a crashed dispatch must not strand the popped
+                    # batch: a crash in the ADMISSION loop rolled its
+                    # slots back (atomic), leaving the batch in neither
+                    # snapshot_in_flight() nor the queue — a
+                    # whole-replica failover (ReplicaFleet) would
+                    # silently lose it. A crash in the jitted dispatch
+                    # AFTER admission leaves the batch in pool.active
+                    # instead, where the snapshot covers it — requeuing
+                    # those too would re-admit every request twice. The
+                    # engine's admission atomicity makes active
+                    # membership the exact discriminator. The
+                    # expiry/cancel completions this tick already
+                    # collected must also be committed before the
+                    # unwind discards `done` (those requests left the
+                    # scheduler AND the engine — nothing else can ever
+                    # retire them). (Requeue may land ahead of
+                    # seed-deferred batch siblings — those were
+                    # colliding anyway.)
+                    seated = {r.id
+                              for r in self.engine.active_requests.values()}
+                    self.scheduler.requeue_front(
+                        [r for r in admit if r.id not in seated])
+                    self._finalize(done)
+                    raise
                 self._ops += 1  # count the dispatch before stamping TTFT
                 t_first = self.now()
                 chunking = getattr(self.engine, "chunk_pending_ids",
@@ -186,6 +235,13 @@ class ServeClient:
                         # chunk-routed: still prefilling, no first token
                         # yet — stamped by _dispatch_chunk on its final
                         # chunk
+                        continue
+                    if req.first_token_time is not None:
+                        # failover re-admission of a request that had
+                        # already streamed tokens on its dead replica:
+                        # its first token happened THERE — re-stamping
+                        # would corrupt TTFT across the fleet's shared
+                        # clock
                         continue
                     self._stamp_first_token(req, t_first)
             else:
@@ -198,14 +254,21 @@ class ServeClient:
                 # the substitute action falls through to the shared
                 # dispatch chain below
                 action = self.scheduler.drain_action(self.engine)
-        if action == ACTION_CHUNK:
-            self._dispatch_chunk(done)
-        elif action == ACTION_STEP:
-            done.extend(self.engine.step())
-            self._ops += 1
-        elif action != ACTION_PREFILL:
-            # idle: advance the tick clock so tick-mode traces progress
-            self._ops += 1
+        try:
+            if action == ACTION_CHUNK:
+                self._dispatch_chunk(done)
+            elif action == ACTION_STEP:
+                done.extend(self.engine.step())
+                self._ops += 1
+            elif action != ACTION_PREFILL:
+                # idle: advance the tick clock so tick-mode traces
+                # progress
+                self._ops += 1
+        except Exception:
+            # same contract as the prefill unwind above: completions
+            # already collected this tick must not vanish with the crash
+            self._finalize(done)
+            raise
         rebuilds = getattr(self.engine, "rebuilds", 0)
         if rebuilds != self._seen_rebuilds:
             # a recovery may drain chunk prefills internally (prefix
@@ -222,6 +285,17 @@ class ServeClient:
             for req in self.engine.active_requests.values():
                 if req.first_token_time is None and req.id not in chunking:
                     self._stamp_first_token(req, t)
+        self._finalize(done)
+        return done
+
+    def _finalize(self, done: List[Completion]) -> None:
+        """Stamp finish times, record completions, and (armed) emit the
+        retirement telemetry. Runs on the normal tick exit AND on a
+        crashed dispatch's unwind: completions collected earlier in the
+        tick (deadline expiries, mid-decode cancels) already left the
+        scheduler and the engine, so discarding them with the stack
+        would lose those requests forever — no failover can re-admit
+        what neither the snapshot nor the queue contains."""
         t_done = self.now()
         for comp in done:
             comp.finish_time = t_done
@@ -233,7 +307,6 @@ class ServeClient:
         tel = self._tel
         if tel is not None:
             self._record_retirements(tel, done)
-        return done
 
     def _dispatch_chunk(self, done: List[Completion]) -> None:
         """One chunk-prefill dispatch, plus TTFT stamping for the request

@@ -104,18 +104,27 @@ class FifoScheduler:
     def waiting(self) -> List[Request]:
         return list(self._queue)
 
+    def oldest_age(self, now: Optional[float]) -> Optional[float]:
+        """How long the queue head has been waiting (clock units), or
+        ``None`` on an empty queue / missing clock data. The fleet
+        router reads this as a live backpressure signal; :class:`QueueFull`
+        carries it as shed context."""
+        if not self._queue or now is None:
+            return None
+        head = self._queue[0]
+        if head.arrival_time is None:
+            return None
+        return now - head.arrival_time
+
     def submit(self, request: Request,
                now: Optional[float] = None) -> None:
         """Enqueue, or raise :class:`QueueFull` — overload sheds at the
         door instead of growing an unbounded backlog."""
         if len(self._queue) >= self.config.max_queue_depth:
-            head = self._queue[0]
-            oldest = (now - head.arrival_time
-                      if now is not None and head.arrival_time is not None
-                      else None)
             raise QueueFull(
                 f"queue at max_queue_depth={self.config.max_queue_depth}",
-                queue_depth=len(self._queue), oldest_age=oldest)
+                queue_depth=len(self._queue),
+                oldest_age=self.oldest_age(now))
         if (request.deadline is None
                 and self.config.default_deadline is not None
                 and now is not None):

@@ -75,6 +75,10 @@ def pytest_configure(config):
         "markers", "serve: the serving stack (engine/scheduler/paged KV/"
         "prefill split) — `pytest -m serve` runs it as a fast targeted "
         "subset")
+    config.addinivalue_line(
+        "markers", "fleet: the replica-fleet serving tier (router/"
+        "supervision/failover/autoscaler) — `pytest -m fleet` runs it as "
+        "a fast targeted subset")
 
 
 @pytest.fixture(autouse=True)

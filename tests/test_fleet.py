@@ -1,0 +1,684 @@
+"""Replica-fleet serving: router, supervision, failover, autoscaling.
+
+The load-bearing assertion is **failover token identity**: a 3-replica
+fleet with replicas killed mid-decode AND mid-chunked-prefill must
+retire every request ``finish_reason != "failed"`` with outputs
+token-for-token identical to an uninterrupted single-engine run — the
+PR 3 replay contract (prompt + emitted tokens re-feed, key streams
+continue at the same ``fold_in`` step) transplanted across engines.
+Everything runs on the deterministic fleet tick clock, so every chaos
+scenario is a pinned ``serve.replica`` fault schedule, and the
+``fleet.failover`` → ``recovery.replay`` → ``fleet.replica_promoted``
+event order is asserted on the Telemetry handle.
+"""
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.obs import Telemetry
+from ray_lightning_tpu.reliability import FaultPlan, FaultSpec
+from ray_lightning_tpu.serve import (FINISH_FAILED, FINISH_TIMEOUT,
+                                     FleetConfig, FleetSaturated, QueueFull,
+                                     ReplicaFleet, Request, Router,
+                                     RouterConfig, SchedulerConfig,
+                                     ServeClient)
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+TRACE = [
+    (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+    (0, dict(prompt=[9, 2, 44], max_new_tokens=6)),
+    (3, dict(prompt=[42, 7], max_new_tokens=5)),
+    (5, dict(prompt=[1], max_new_tokens=6)),
+]
+
+#: the paged/chunked engine shape every replica (and the single-engine
+#: reference, scaled up so nothing queues) compiles in the chaos tests
+PAGED = dict(num_slots=2, prefill_len=16, page_size=4, num_pages=32,
+             prefill_chunk=8)
+
+
+def _ref(dec, params, trace, **kw):
+    """Uninterrupted single-engine reference, sized to admit everything."""
+    kw.setdefault("num_slots", 8)
+    kw.setdefault("prefill_len", 32)
+    client = ServeClient(dec, params, **kw)
+    out = client.serve_trace(trace)
+    client.shutdown()
+    return out
+
+
+def _chunk_trace():
+    rng = np.random.default_rng(3)
+    long1 = [int(t) for t in rng.integers(0, 128, size=20)]
+    long2 = [int(t) for t in rng.integers(0, 128, size=24)]
+    return [
+        (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=8)),
+        (0, dict(prompt=long1, max_new_tokens=8)),
+        (1, dict(prompt=[9, 2, 44], max_new_tokens=8)),
+        (4, dict(prompt=long2, max_new_tokens=6)),
+        (6, dict(prompt=[42, 7], max_new_tokens=6)),
+    ]
+
+
+# --------------------------------------------------------------------- #
+# routing
+# --------------------------------------------------------------------- #
+def test_fleet_greedy_matches_single_engine(nano):
+    """No faults: a 3-replica fleet serving a staggered trace emits
+    exactly the single-engine tokens (decode math is replica-independent)
+    and the router spreads simultaneous arrivals by least load, lowest
+    id first — deterministic."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, num_replicas=3, num_slots=2,
+                         prefill_len=16, telemetry=tel)
+    out = fleet.serve_trace(TRACE)
+    ref = _ref(dec, params, TRACE, prefill_len=16)
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason == ref[rid].finish_reason
+        assert out[rid].latency is not None
+        assert out[rid].time_to_first_token is not None
+    # the two t=0 arrivals land on different (least-loaded) replicas,
+    # id order breaking the tie
+    routes = [e.payload["replica"] for e in tel.events("fleet.route")]
+    assert routes[:2] == [0, 1]
+    assert fleet.router.decisions == len(TRACE)
+    fleet.shutdown()
+    assert fleet.replicas_live == 0
+
+
+def test_router_prefers_affine_replica_for_shared_prefix(nano):
+    """Prefix affinity: a request sharing the first chunk with an
+    earlier one routes to the replica that published those pages — and
+    adopts them (prefix_hit_tokens > 0) — even though load balancing
+    alone would pick an idler replica."""
+    dec, params = nano
+    tel = Telemetry()
+    shared = list(range(40, 56))  # 16 tokens = 2 chunks
+    trace = [
+        (0, dict(prompt=shared + [1, 2], max_new_tokens=4)),
+        # arrives after the first finished prefilling + publishing
+        (16, dict(prompt=shared + [7, 8, 9], max_new_tokens=4)),
+    ]
+    fleet = ReplicaFleet(dec, params, num_replicas=3, num_slots=2,
+                         prefill_len=16, page_size=4, num_pages=48,
+                         prefill_chunk=8, prefix_cache=True, telemetry=tel)
+    out = fleet.serve_trace(trace)
+    routes = {e.payload["id"]: e.payload for e in tel.events("fleet.route")}
+    assert routes[1]["replica"] == routes[0]["replica"]
+    assert routes[1]["affinity"] is True
+    assert out[1].prefix_hit_tokens > 0
+    assert fleet.router.affinity_hits == 1
+    ref = _ref(dec, params, trace, page_size=4, num_pages=96,
+               prefill_chunk=8, prefix_cache=True)
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+    fleet.shutdown()
+
+
+def test_all_replicas_full_raises_aggregated_queue_full(nano):
+    """Satellite: per-replica refusals shed to the next candidate; only
+    when EVERY replica refuses does the fleet raise — a FleetSaturated
+    that IS a QueueFull, carrying the aggregated occupancy context."""
+    dec, params = nano
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=2, num_slots=1, prefill_len=8,
+        scheduler_config=SchedulerConfig(max_queue_depth=1))
+    # fill both slots...
+    fleet.submit([3, 1], max_new_tokens=12)
+    fleet.submit([3, 2], max_new_tokens=12)
+    fleet.tick()
+    # ...then both queue seats; the 5th submit has nowhere to shed TO
+    fleet.submit([3, 3], max_new_tokens=12)
+    fleet.submit([3, 4], max_new_tokens=12)
+    with pytest.raises(QueueFull) as err:
+        fleet.submit([3, 5], max_new_tokens=12)
+    exc = err.value
+    assert isinstance(exc, FleetSaturated)
+    assert exc.queue_depth == 2       # one waiter per replica
+    assert exc.replicas == 2          # both were offered the request
+    assert exc.oldest_age is not None and exc.oldest_age >= 0
+    assert "queue_depth=2" in str(exc)
+    fleet.run_until_idle()
+    assert all(c.finish_reason != FINISH_FAILED
+               for c in fleet.completions.values())
+    fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# failover
+# --------------------------------------------------------------------- #
+def test_fleet_chaos_failover_token_identity(nano):
+    """PINNED (the acceptance scenario): serve.replica kills one replica
+    mid-chunked-prefill (tick 4: replica 1, chunking=1) and one
+    mid-decode (tick 12: replica 0, in-flight decode row) on a
+    3-replica paged fleet with warm standbys. Every request retires
+    finish_reason != "failed", greedy outputs are token-identical to an
+    uninterrupted single-engine run, and the failover →
+    recovery.replay → replica_promoted event order is pinned."""
+    dec, params = nano
+    trace = _chunk_trace()
+    ref = _ref(dec, params, trace, page_size=4, num_pages=96,
+               prefill_chunk=8)
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, num_replicas=3, num_standby=2,
+                         telemetry=tel, **PAGED)
+    plan = FaultPlan.at("serve.replica", [4, 12])
+    with plan.armed():
+        out = fleet.serve_trace(trace)
+    assert plan.fired == 2
+    assert fleet.failovers == 2
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, \
+            (rid, out[rid].tokens, ref[rid].tokens)
+        assert out[rid].finish_reason != FINISH_FAILED
+    # one kill landed mid-chunked-prefill, the other mid-decode
+    failovers = [e.payload for e in tel.events("fleet.failover")]
+    assert failovers[0]["chunking"] == 1 and failovers[0]["dead"]
+    assert failovers[1]["chunking"] == 0 and failovers[1]["in_flight"] == 1
+    # the pinned order, per failover wave
+    sites = [e.site for e in tel.events()
+             if e.site in ("fleet.failover", "recovery.replay",
+                           "fleet.replica_promoted")]
+    assert sites == ["fleet.failover", "recovery.replay",
+                     "fleet.replica_promoted"] * 2
+    promoted = [e.payload for e in tel.events("fleet.replica_promoted")]
+    assert all(p["source"] == "standby" for p in promoted)
+    assert fleet.replicas_live == 3  # capacity restored
+    snap = tel.metrics.snapshot()
+    assert snap["serve_fleet_failovers_total"] == 2
+    assert snap["serve_fleet_readmitted_requests_total"] >= 2
+    assert snap["serve_fleet_replicas_live"] == 3
+    assert snap["serve_fleet_router_load"]["count"] >= len(trace)
+    fleet.shutdown()
+
+
+def test_fleet_failover_sampled_replay_exact(nano):
+    """Replay exactness beyond greedy: temperature>0 streams continue
+    their per-request key stream across a replica kill — the key is a
+    pure function of (engine seed, request seed, step), never of which
+    replica/slot hosts the row."""
+    dec, params = nano
+    trace = [
+        (0, dict(prompt=[5, 17, 3], max_new_tokens=8, temperature=0.9,
+                 top_k=20, seed=11)),
+        (1, dict(prompt=[9, 2], max_new_tokens=8, temperature=0.7,
+                 seed=23, eos_id=100)),
+        (2, dict(prompt=[42], max_new_tokens=8, eos_id=100)),
+    ]
+    ref = _ref(dec, params, trace)
+    fleet = ReplicaFleet(dec, params, num_replicas=3, num_standby=1,
+                         num_slots=2, prefill_len=24)
+    plan = FaultPlan.at("serve.replica", [9])  # mid-decode
+    with plan.armed():
+        out = fleet.serve_trace(trace)
+    assert plan.fired == 1 and fleet.failovers == 1
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason == ref[rid].finish_reason
+    fleet.shutdown()
+
+
+def test_failover_preserves_timing_fields_and_deadline(nano):
+    """Satellite regression: across a mid-decode replica kill the
+    re-admitted request keeps its original arrival time and its
+    first-token stamp (never re-stamped on the survivor), and its
+    submit-time deadline still fires — re-admission does not grant a
+    fresh deadline — cancelling it with the tokens it already earned."""
+    dec, params = nano
+    trace = [
+        (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+        (0, dict(prompt=[9, 2, 44], max_new_tokens=24, deadline=14.0)),
+        (3, dict(prompt=[42, 7], max_new_tokens=6)),
+        (5, dict(prompt=[1], max_new_tokens=6)),
+    ]
+
+    def run(plan=None):
+        fleet = ReplicaFleet(dec, params, num_replicas=3, num_standby=1,
+                             num_slots=2, prefill_len=16)
+        if plan is None:
+            out = fleet.serve_trace(trace)
+        else:
+            with plan.armed():
+                out = fleet.serve_trace(trace)
+        fleet.shutdown()
+        return out
+
+    base = run()
+    # tick 7 kills replica 1 = request 1's host, well into its decode
+    out = run(FaultPlan.at("serve.replica", [7]))
+    victim, ref = out[1], base[1]
+    assert ref.first_token_time is not None
+    assert victim.arrival_time == ref.arrival_time == 0.0
+    assert victim.first_token_time == ref.first_token_time  # no re-stamp
+    assert victim.finish_reason == FINISH_TIMEOUT == ref.finish_reason
+    assert victim.finish_time >= 14.0
+    # the stream it kept is a prefix of the uninterrupted stream (the
+    # failover pause costs ticks, never tokens)
+    assert victim.tokens and victim.tokens == ref.tokens[:len(victim.tokens)]
+    # bystanders: token-identical, untouched timing
+    for rid in (0, 2, 3):
+        assert out[rid].tokens == base[rid].tokens, rid
+        assert out[rid].arrival_time == base[rid].arrival_time
+
+
+def test_hang_detection_drains_stalled_replica(nano):
+    """A serve.replica stall latches a wedged dispatch loop: the
+    replica stops beating, the driver-clock ledger declares it silent
+    within heartbeat_timeout ticks, and its work fails over exactly
+    like a death — no request lost, tokens identical."""
+    dec, params = nano
+    ref = _ref(dec, params, TRACE, prefill_len=16)
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=3, num_standby=1, num_slots=2,
+        prefill_len=16, telemetry=tel,
+        fleet_config=FleetConfig(heartbeat_timeout=3.0))
+    plan = FaultPlan([FaultSpec("serve.replica", 4, mode="stall",
+                                stall_s=0.0)])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert plan.fired == 1 and fleet.failovers == 1
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason != FINISH_FAILED
+    failover = tel.events("fleet.failover")[0].payload
+    assert failover["dead"] is False           # the hang verdict
+    assert failover["beat_age"] > 3.0          # silent past the timeout
+    assert failover["beat_age"] <= 5.0         # ...but bounded
+    fleet.shutdown()
+
+
+def test_sole_replica_death_promotes_then_replays(nano):
+    """A 1-replica fleet killed mid-decode promotes BEFORE re-admission
+    (there is no survivor to replay onto otherwise) and still finishes
+    every request token-identically."""
+    dec, params = nano
+    ref = _ref(dec, params, TRACE, prefill_len=16)
+    fleet = ReplicaFleet(dec, params, num_replicas=1, num_standby=1,
+                         num_slots=4, prefill_len=16)
+    plan = FaultPlan.at("serve.replica", [3])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert plan.fired == 1 and fleet.failovers == 1
+    assert fleet.replicas_live == 1
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason != FINISH_FAILED
+    fleet.shutdown()
+
+
+def test_engine_crash_mid_prefill_loses_no_popped_requests(nano):
+    """Review regression: a serve.dispatch crash at a replica's FIRST
+    prefill fires after the scheduler popped the admit batch but before
+    any slot held it — so the batch is in neither snapshot_in_flight()
+    nor scheduler.waiting when the fleet drains the replica. The client
+    must requeue the popped batch on a crashed dispatch or those
+    requests vanish without a completion."""
+    dec, params = nano
+    ref = _ref(dec, params, TRACE, prefill_len=16)
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_standby=1,
+                         num_slots=2, prefill_len=16)
+    plan = FaultPlan.at("serve.dispatch", [0])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert plan.fired == 1 and fleet.failovers == 1
+    assert sorted(out) == sorted(ref)  # nobody vanished
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason != FINISH_FAILED
+    fleet.shutdown()
+
+
+def test_post_admission_crash_does_not_duplicate_requests(nano,
+                                                          monkeypatch):
+    """Review regression: a crash INSIDE the jitted prefill — after the
+    admission loop seated the batch — leaves those requests in
+    pool.active, where the failover snapshot already covers them;
+    requeuing them too would re-admit every request twice (two replicas
+    decoding the same mutable Request). The client's crash handler must
+    requeue only requests admission rolled back."""
+    dec, params = nano
+    from ray_lightning_tpu.serve import engine as engine_mod
+    real = engine_mod._prefill_inject_plain
+    state = {"crashed": False}
+
+    def crash_once(*args, **kwargs):
+        if not state["crashed"]:
+            state["crashed"] = True
+            raise RuntimeError("device preempted mid-dispatch")
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(engine_mod, "_prefill_inject_plain", crash_once)
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_standby=1,
+                         num_slots=2, prefill_len=16)
+    out = fleet.serve_trace(TRACE)
+    assert state["crashed"] and fleet.failovers == 1
+    # the crashed batch (request 0 — its t=0 sibling routed to replica
+    # 1) came back through the SNAPSHOT path only: one replay, no
+    # queued duplicate (the bug doubles this to 2)
+    assert fleet.readmitted == 1
+    ref = _ref(dec, params, TRACE, prefill_len=16)
+    assert sorted(out) == sorted(ref)
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason != FINISH_FAILED
+    fleet.shutdown()
+
+
+def test_expiry_completion_on_crash_tick_is_not_lost(nano):
+    """Review regression: a deadline expiry collected at the top of the
+    same tick whose prefill dispatch then crashes left the request in
+    neither the snapshot nor the queue — its FINISH_TIMEOUT completion
+    must be committed before the unwind, or it vanishes from the fleet's
+    results entirely."""
+    dec, params = nano
+    fleet = ReplicaFleet(dec, params, num_replicas=1, num_standby=1,
+                         num_slots=1, prefill_len=8)
+    fleet.submit([5, 17], max_new_tokens=3)                 # slot holder
+    fleet.submit([9, 2], max_new_tokens=4, deadline=3.0)    # expires queued
+    fleet.submit([42, 7], max_new_tokens=3)  # admitted on the crash tick
+    # serve.dispatch tick 3 = the prefill backfilling the freed slot, on
+    # the same fleet tick (now=3.0) the deadline drops request 1
+    plan = FaultPlan.at("serve.dispatch", [3])
+    with plan.armed():
+        out = fleet.run_until_idle()
+    assert plan.fired == 1 and fleet.failovers == 1
+    assert sorted(out) == [0, 1, 2]  # nobody vanished
+    assert out[1].finish_reason == FINISH_TIMEOUT
+    assert out[1].finish_time is not None
+    assert out[0].finish_reason != FINISH_FAILED
+    assert out[2].finish_reason != FINISH_FAILED
+    assert len(out[2].tokens) == 3  # requeued + re-served after failover
+    fleet.shutdown()
+
+
+def test_failover_capacity_restored_at_tick_time(nano):
+    """Review regression: a failover that finds the standby pool empty
+    (raced refill — or no pool at all) must not shrink the fleet
+    forever. The failover itself promotes nothing (above
+    min_replicas), and the next tick's catch-up restores toward the
+    target count — cold here, since nothing warm has landed."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, num_replicas=3, num_standby=1,
+                         num_slots=2, prefill_len=16, telemetry=tel)
+    # model the race deterministically: the pool is empty at kill time
+    fleet.standby.take().shutdown()
+    plan = FaultPlan.at("serve.replica", [3])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert fleet.failovers == 1
+    assert all(c.finish_reason != FINISH_FAILED for c in out.values())
+    assert fleet.replicas_live == 3  # restored, not stuck at 2
+    promoted = tel.events("fleet.replica_promoted")[0].payload
+    assert promoted["source"] == "cold"
+    assert promoted["replicas_live"] == 3
+    fleet.shutdown()
+
+
+def test_hang_clock_survives_membership_churn(nano):
+    """Review regression: the monitor is rebuilt on every membership
+    change, and a rebuild used to restamp everyone — a sibling's
+    failover landing while a replica sat wedged reset its silence
+    clock (recurring churn would defer the verdict forever) and wiped
+    the postmortem the failover event reports. The carried per-replica
+    ledger keeps the real beat ages across rebuilds."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=3, num_standby=2, num_slots=2,
+        prefill_len=16, telemetry=tel,
+        fleet_config=FleetConfig(heartbeat_timeout=6.0))
+    # replica 1 wedges on fleet round 1; replica 2 is killed one round
+    # later (tick 7: stalled replicas stop firing, so round 2 fires
+    # replicas 0,2 at ticks 6,7) — the kill's rebuild lands mid-silence
+    plan = FaultPlan([
+        FaultSpec("serve.replica", 4, mode="stall", stall_s=0.0),
+        FaultSpec("serve.replica", 7),
+    ])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert fleet.failovers == 2
+    assert all(c.finish_reason != FINISH_FAILED for c in out.values())
+    hang = [e.payload for e in tel.events("fleet.failover")
+            if e.payload["dead"] is False]
+    assert len(hang) == 1
+    # the postmortem carries the REAL ledger across the sibling's
+    # rebuild: a restamped monitor would report last_dispatch=-1 and a
+    # beat age measured from the rebuild
+    assert hang[0]["last_dispatch"] >= 1
+    assert 6.0 < hang[0]["beat_age"] <= 8.0  # detection stayed bounded
+    fleet.shutdown()
+
+
+def test_standby_pool_promotion_and_background_refill(nano):
+    """Failover promotes a warm standby (promotion, not spawn, on the
+    critical path) and the pool refills on a background thread right
+    after."""
+    dec, params = nano
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_standby=1,
+                         num_slots=2, prefill_len=16)
+    assert fleet.standby.available() == 1
+    plan = FaultPlan.at("serve.replica", [2])
+    with plan.armed():
+        fleet.serve_trace(TRACE)
+    assert fleet.standby.promotions == 1
+    assert fleet.replicas_live == 2
+    thread = fleet.standby._refill_thread
+    if thread is not None:
+        thread.join(timeout=30)
+    assert fleet.standby.available() == 1  # refilled off the hot path
+    fleet.shutdown()
+    assert fleet.standby.available() == 0
+
+
+def test_unreplayable_request_fails_with_partial_tokens(nano):
+    """A request whose prompt + emitted tokens outgrew the replay
+    window (prefill_len, unchunked) cannot move to a survivor: it
+    retires finish_reason="failed" WITH the tokens it earned, the fleet
+    cold-builds back to min_replicas, and later traffic is served
+    normally (failures shed requests, never the server)."""
+    dec, params = nano
+    logging.disable(logging.ERROR)
+    try:
+        fleet = ReplicaFleet(dec, params, num_replicas=1, num_slots=4,
+                             prefill_len=8)
+        # prompt 4 + 5 emitted by the kill tick > prefill_len=8
+        plan = FaultPlan.at("serve.replica", [5])
+        with plan.armed():
+            fleet.submit([5, 17, 3, 9], max_new_tokens=10)
+            out = fleet.run_until_idle()
+    finally:
+        logging.disable(logging.NOTSET)
+    assert out[0].finish_reason == FINISH_FAILED
+    assert len(out[0].tokens) == 5  # partial tokens kept
+    assert fleet.readmit_failed == 1
+    assert fleet.replicas_live == 1  # cold-built replacement seated
+    # the fleet still serves once the chaos stops
+    rid = fleet.submit([1, 2], max_new_tokens=3)
+    out = fleet.run_until_idle()
+    assert out[rid].finish_reason != FINISH_FAILED
+    assert len(out[rid].tokens) == 3
+    fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# autoscaler
+# --------------------------------------------------------------------- #
+def test_autoscaler_scales_out_under_pressure_and_drains_back(nano):
+    """Queue pressure past the hysteresis window adds a replica (warm
+    standby first); sustained idleness drains one — stop admitting, let
+    in-flight retire, only then shut down — never dipping below
+    min_replicas. All completions stay correct."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=1, num_standby=1, num_slots=1,
+        prefill_len=8, telemetry=tel,
+        fleet_config=FleetConfig(autoscale=True, min_replicas=1,
+                                 max_replicas=2,
+                                 scale_out_queue_depth=2.0, hysteresis=2))
+    trace = [(0, dict(prompt=[7, i + 1], max_new_tokens=6))
+             for i in range(6)]
+    out = fleet.serve_trace(trace)
+    assert fleet.scale_outs >= 1
+    scale_out = tel.events("fleet.scale_out")[0].payload
+    assert scale_out["source"] == "standby"
+    assert scale_out["replicas_live"] == 2
+    ref = _ref(dec, params, trace, prefill_len=8)
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+    # idle ticks after the burst drain the extra replica back down
+    for _ in range(12):
+        fleet.tick()
+    assert fleet.scale_ins == 1
+    assert fleet.replicas_live == 1
+    sites = [e.site for e in tel.events()
+             if e.site in ("fleet.replica_draining", "fleet.scale_in")]
+    assert sites == ["fleet.replica_draining", "fleet.scale_in"]
+    fleet.shutdown()
+
+
+def test_draining_replica_finishes_in_flight_work(nano):
+    """Scale-in is a drain, not a kill: the victim's in-flight request
+    retires normally (full token budget) before the replica is removed."""
+    dec, params = nano
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=2, num_slots=1, prefill_len=8,
+        fleet_config=FleetConfig(autoscale=True, min_replicas=1,
+                                 max_replicas=2, hysteresis=1))
+    fleet.submit([5, 1], max_new_tokens=10)
+    fleet.submit([5, 2], max_new_tokens=10)
+    fleet.tick()  # both admitted, one per replica; queues now empty ->
+    fleet.tick()  # idle verdict marks the newest replica draining
+    drained = [r for r in fleet._replicas if r.draining]
+    assert len(drained) == 1 and drained[0].id == 1
+    out = fleet.run_until_idle()
+    for _ in range(3):
+        fleet.tick()
+    assert len(out[0].tokens) == 10 and len(out[1].tokens) == 10
+    assert fleet.replicas_live == 1
+    fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# configs, determinism, disarmed surface
+# --------------------------------------------------------------------- #
+def test_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(heartbeat_timeout=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        FleetConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        FleetConfig(hysteresis=0)
+    with pytest.raises(ValueError):
+        RouterConfig(affinity_tokens=-1)
+    with pytest.raises(ValueError):
+        RouterConfig(ttft_alpha=0.0)
+    with pytest.raises(ValueError):
+        RouterConfig(affinity_capacity=0)
+
+
+def test_fleet_rejects_bad_shapes(nano):
+    dec, params = nano
+    with pytest.raises(ValueError):
+        ReplicaFleet(dec, params, num_replicas=0)
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_slots=1,
+                         prefill_len=8)
+    with pytest.raises(ValueError):
+        # can never fit any replica's compiled shapes: refused at
+        # submit, not shed round-robin
+        fleet.submit(list(range(20)), max_new_tokens=4)
+    fleet.shutdown()
+
+
+def test_fleet_trace_replays_identically(nano):
+    """Tick-clock determinism fleet-wide: the same trace + the same
+    fault plan schedule produce byte-identical completions (tokens AND
+    timing stamps) across runs."""
+    dec, params = nano
+
+    def run():
+        fleet = ReplicaFleet(dec, params, num_replicas=3, num_standby=1,
+                             num_slots=2, prefill_len=16)
+        plan = FaultPlan.at("serve.replica", [7])
+        with plan.armed():
+            out = fleet.serve_trace(TRACE)
+        fleet.shutdown()
+        return {
+            rid: (c.tokens, c.finish_reason, c.arrival_time,
+                  c.first_token_time, c.finish_time)
+            for rid, c in out.items()}
+
+    assert run() == run()
+
+
+def test_disarmed_fleet_has_zero_telemetry_surface(nano):
+    """telemetry=None (the default): no handle reaches any layer — the
+    fleet, router, monitor, replicas, engines and standby pool all hold
+    None and never allocate an event/metric object — while failover
+    still works."""
+    dec, params = nano
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_standby=1,
+                         num_slots=2, prefill_len=16)
+    assert fleet._tel is None
+    assert fleet.router._tel is None
+    assert fleet._monitor._tel is None
+    assert fleet.standby._tel is None
+    for rep in fleet._replicas:
+        assert rep.client._tel is None
+        assert rep.client.engine._tel is None
+    plan = FaultPlan.at("serve.replica", [3])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert all(c.finish_reason != FINISH_FAILED for c in out.values())
+    # promotion kept the disarmed contract on the new replica too
+    for rep in fleet._replicas:
+        assert rep.client._tel is None
+    fleet.shutdown()
+
+
+def test_standalone_router_reads_config_affinity():
+    """RouterConfig.affinity_tokens is the source of truth for a
+    directly constructed Router (the fleet passes its engine-resolved
+    count explicitly); the config field must not be dead state."""
+    router = Router(RouterConfig(affinity_tokens=3))
+    assert router.affinity_tokens == 3
+    assert router._key(Request(id=0, prompt=[1, 2, 3, 4],
+                               max_new_tokens=1)) == (1, 2, 3)
+    assert Router(RouterConfig()).affinity_tokens == 0  # auto, no engine
+    assert Router(RouterConfig(affinity_tokens=5),
+                  affinity_tokens=0).affinity_tokens == 0  # explicit wins
+
+
+def test_router_shutdown_clears_state(nano):
+    dec, params = nano
+    router = Router(RouterConfig(), affinity_tokens=2)
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_slots=2,
+                         prefill_len=8)
+    fleet.submit([1, 2, 3], max_new_tokens=2)
+    fleet.run_until_idle()
+    assert fleet.router.decisions == 1
+    fleet.shutdown()
+    assert not fleet.router._affinity and not fleet.router._ttft
+    router.shutdown()  # standalone router: idempotent no-op

@@ -35,6 +35,10 @@ RESOURCE_FACTORIES = {
     "_make_queue_channel", "make_queue", "Queue", "Manager",
     "GangMonitor", "StandbyPool", "MemoryCheckpointStore",
     "KVSlotPool", "PagePool", "PrefixCache",
+    # the fleet tier: a ReplicaFleet owns N engines' device memory plus
+    # (optionally) a standby pool; a Router owns the affinity/EWMA maps
+    # that must not outlive their replicas — both release in shutdown()
+    "ReplicaFleet", "Router",
 }
 
 RELEASE_METHODS = {"shutdown", "close", "_kill", "kill"}
