@@ -200,6 +200,35 @@ def decode_step(model, params, cache, tokens: jax.Array,
     return _logits_only(outputs)[:, -1], updated["cache"]
 
 
+def verify_step(model, params, cache, tokens: jax.Array,
+                kv_positions: jax.Array):
+    """ONE cached block-scoring step at per-row positions — the target
+    side of speculative decoding (:mod:`ray_lightning_tpu.serve.spec`).
+
+    ``tokens`` (B, T) holds each row's current token followed by its
+    T-1 draft proposals; ``kv_positions`` (B, T) their absolute
+    positions (the contiguous run ``pos..pos+T-1`` per row). The step
+    block-writes each row's K/V at its own positions (the per-row block
+    mode of ``_decode_cache``) under a block-causal mask, so ONE
+    dispatch scores every draft token exactly as T sequential
+    :func:`decode_step` calls would: offset ``j``'s logits are the
+    target's next-token distribution given the row's context plus
+    drafts ``< j``.
+
+    Returns ``(logits (B, T, V), cache)`` — all T positions' logits
+    (the accept rule needs every offset, not just the last). Rejected
+    drafts' K/V stays in the cache at positions past the commit point;
+    that is deliberate rollback-by-position-decrement: later writes
+    land at or before those positions before any mask re-admits them
+    (same argument as the chunk-prefill path).
+    """
+    outputs, updated = model.apply(
+        {"params": params, "cache": cache}, tokens,
+        positions=kv_positions, kv_positions=kv_positions,
+        deterministic=True, mutable=["cache"])
+    return _logits_only(outputs), updated["cache"]
+
+
 def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
     B, P = prompt_tokens.shape
     prompt_tokens = prompt_tokens.astype(jnp.int32)

@@ -213,11 +213,17 @@ class MultiHeadAttention(nn.Module):
           prompt in one ``dynamic_update_slice``). The returned additive
           mask is intra-block causal over the cache buffer: query ``q`` of
           the block attends positions ``<= cache_index + q``.
-        - ``kv_positions`` (B, 1) — per-row single-token write at each
-          row's own absolute position (ragged decode: rows sit at
-          different sequence lengths). Lowered as a vmapped
-          ``dynamic_update_slice`` (a batched scatter); the mask is
-          per-row ``key <= kv_positions[row]``.
+        - ``kv_positions`` (B, T) — per-row block write of ``T >= 1``
+          tokens at each row's own absolute positions (ragged decode:
+          rows sit at different sequence lengths; T=1 is the classic
+          per-row decode step, T>1 is the speculative-decode verify
+          program scoring a row's draft block in one pass). Positions
+          must be the contiguous run ``kv_positions[row, 0] + 0..T-1``
+          — the write is one vmapped ``dynamic_update_slice`` per row
+          at that start (a batched scatter); the mask is per-row,
+          per-query ``key <= kv_positions[row, q]`` (block-causal over
+          the cache, the ragged sibling of the shared-index block
+          mode).
 
         The scalar ``cache_index`` advances by ``T`` either way; in the
         per-row mode it is bookkeeping only (positions come from the
@@ -238,20 +244,19 @@ class MultiHeadAttention(nn.Module):
                                            (1, 1, 1, cfg.max_seq_len), 3)
         big_neg = jnp.finfo(jnp.float32).min
         if kv_positions is not None:
-            if T != 1:
-                raise ValueError(
-                    f"per-row kv_positions writes are single-token, got "
-                    f"T={T}; block (prefill) writes use the shared index "
-                    "(kv_positions=None)")
-            pos = kv_positions[:, 0].astype(jnp.int32)          # (B,)
+            pos = kv_positions.astype(jnp.int32)                # (B, T)
+            start = pos[:, 0]                                   # (B,)
             row_write = jax.vmap(
                 lambda c, u, i: jax.lax.dynamic_update_slice(c, u,
                                                              (i, 0, 0)))
-            ck.value = row_write(ck.value, k, pos)
-            cv.value = row_write(cv.value, v, pos)
+            ck.value = row_write(ck.value, k, start)
+            cv.value = row_write(cv.value, v, start)
             ci.value = ci.value + T
-            mask = jnp.where(key_pos <= pos[:, None, None, None], 0.0,
-                             big_neg)                           # (B,1,1,S)
+            # per-row, per-query: query q of the block attends keys at
+            # positions <= pos[row, q] — block-causal, covering the
+            # block's own just-written K/V up to each query
+            mask = jnp.where(key_pos <= pos[:, None, :, None], 0.0,
+                             big_neg)                           # (B,1,T,S)
             return ck.value, cv.value, mask
         idx = ci.value
         ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
@@ -498,10 +503,11 @@ class TransformerLM(nn.Module):
     required in decode mode, where each single-token call sits at the
     current cache index (see :mod:`ray_lightning_tpu.models.generate`).
 
-    ``kv_positions`` (B, 1) switches the decode KV cache to per-row
+    ``kv_positions`` (B, T) switches the decode KV cache to per-row
     writes at explicit absolute positions (ragged batches where rows sit
-    at different lengths); leave None for the shared-index path (uniform
-    decode steps and block prefill).
+    at different lengths; T>1 is a per-row contiguous block write — the
+    speculative-decode verify path); leave None for the shared-index
+    path (uniform decode steps and block prefill).
 
     ``return_hidden=True`` returns the final hidden states (after
     ``ln_f``) instead of logits, for the chunked LM-head loss path

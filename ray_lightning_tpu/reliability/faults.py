@@ -33,6 +33,14 @@ Sites are woven into the hot paths as a single ``fire(site)`` call:
                       ``stall`` wedges its dispatch loop (heartbeats
                       stop; the fleet's hang verdict). Carries the
                       replica's stable id as ``rank``.
+``serve.verify``      per speculative-decode dispatch, after the draft
+                      refills and immediately before the fused
+                      draft+verify program — ``raise`` crashes the
+                      verify (the supervisor's rebuild-and-replay path,
+                      token-identical greedy recovery), ``stall``
+                      wedges it (deadline pressure on every in-flight
+                      row). Only fires on engines armed with a
+                      ``draft_model``.
 ====================  ====================================================
 
 The worker sites additionally carry the firing worker's **rank**
@@ -74,6 +82,7 @@ SITE_WORKER_EXIT = "worker.exit"
 SITE_WORKER_STALL = "worker.stall"
 SITE_RENDEZVOUS_INIT = "rendezvous.init"
 SITE_SERVE_REPLICA = "serve.replica"
+SITE_SERVE_VERIFY = "serve.verify"
 
 MODE_RAISE = "raise"
 MODE_NAN = "nan"
@@ -94,6 +103,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     SITE_WORKER_STALL: (MODE_STALL, MODE_RAISE),
     SITE_RENDEZVOUS_INIT: (MODE_RAISE, MODE_STALL),
     SITE_SERVE_REPLICA: (MODE_RAISE, MODE_STALL),
+    SITE_SERVE_VERIFY: (MODE_RAISE, MODE_STALL),
 }
 
 

@@ -13,7 +13,13 @@ a request that had emitted ``k`` tokens — see
 token-identical with and without faults; sampled outputs are
 replay-exact because the per-request key stream is a pure function of
 ``(engine seed, request seed, step)``, never of slots or batch
-composition. After the retry policy is exhausted the in-flight requests
+composition. Speculative engines (``draft_model=``) replay through the
+same path — including ``serve.verify`` crashes — with the engine
+discarding the replay prefill's own sample so the next spec round
+regenerates step ``k`` through the rejection rule off the same keys
+(the spec stream's token at a step is that composition, not a plain
+draw); the rebuilt engine's draft KV refills automatically because
+every replay activation marks its slot stale. After the retry policy is exhausted the in-flight requests
 retire as ``finish_reason="failed"`` completions — the client loop and
 the waiting queue keep running; overload and crashes shed *requests*,
 not the server.

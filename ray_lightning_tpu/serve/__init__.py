@@ -23,11 +23,13 @@ from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_TIMEOUT, Request)
 from ray_lightning_tpu.serve.scheduler import (FifoScheduler, QueueFull,
                                                SchedulerConfig)
+from ray_lightning_tpu.serve.spec import SpecDecoder
 
 __all__ = [
     "ServeClient", "ServeEngine", "KVSlotPool", "PagePool", "PrefixCache",
-    "SlotPoolFull", "Request", "Completion", "FifoScheduler", "QueueFull",
-    "SchedulerConfig", "ReplicaFleet", "Router", "RouterConfig",
-    "FleetConfig", "FleetSaturated", "FINISH_EOS", "FINISH_FAILED",
-    "FINISH_LENGTH", "FINISH_REJECTED", "FINISH_TIMEOUT",
+    "SlotPoolFull", "SpecDecoder", "Request", "Completion",
+    "FifoScheduler", "QueueFull", "SchedulerConfig", "ReplicaFleet",
+    "Router", "RouterConfig", "FleetConfig", "FleetSaturated",
+    "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",
+    "FINISH_TIMEOUT",
 ]

@@ -50,14 +50,19 @@ class ServeClient:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 kv_dtype: Optional[str] = None,
+                 draft_model=None, draft_params=None,
+                 spec_k: Optional[int] = None):
         engine_kwargs = dict(
             num_slots=num_slots, prefill_batch=prefill_batch,
             prefill_len=prefill_len,
             steps_per_dispatch=steps_per_dispatch, seed=seed,
             telemetry=telemetry, page_size=page_size,
             num_pages=num_pages, prefill_chunk=prefill_chunk,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, kv_dtype=kv_dtype,
+            draft_model=draft_model, draft_params=draft_params,
+            spec_k=spec_k)
         if retry_policy is not None:
             # supervised engine: dispatch crashes rebuild + replay under
             # the policy instead of unwinding through the client loop;

@@ -29,6 +29,18 @@ prefill/decode split from :mod:`ray_lightning_tpu.models.generate`:
    in-flight decodes by one chunk, not one prompt (Sarathi-style chunked
    prefill). Prefix-cache hits enter here too: adopted pages skip
    straight to the first un-cached offset.
+4. **spec round** (``draft_model=`` engines,
+   :mod:`ray_lightning_tpu.serve.spec`): ``step()`` swaps the decode
+   step for ONE fused program per dispatch — k+1 cheap draft-model
+   steps plus a widened ``(B, k+1)`` target verify whose accept rule
+   commits 1..k+1 tokens per row (greedy token-identical to the plain
+   step by construction; rejected drafts roll back by position
+   decrement). ``steps_per_dispatch`` scans spec ROUNDS here.
+
+``kv_dtype="int8"`` additionally stores KV at rest as absmax int8 +
+f32 scales (per-page-per-head paged, per-position-per-head dense) —
+dequantized on the way into every program and re-quantized on the way
+out, fused into the dispatch; compute stays at ``cfg.dtype``.
 
 KV layout is split from the programs (the refactor ROADMAP item 1 calls
 healthy): the *logical* per-slot ``(max_seq_len, H, D)`` KV each program
@@ -69,7 +81,17 @@ from ray_lightning_tpu.models.transformer import latch_eos
 from ray_lightning_tpu.obs.spans import NULL_SPAN
 from ray_lightning_tpu.reliability import faults
 from ray_lightning_tpu.serve.pages import (PagePool, PrefixCache,
-                                           SlotPoolFull, check_seed_free)
+                                           SlotPoolFull, check_kv_dtype,
+                                           check_seed_free,
+                                           dense_storage_commit,
+                                           dense_storage_values, fold_rows,
+                                           gather_pages, pick_donated,
+                                           quantize_dense_cache,
+                                           scatter_pages)
+from ray_lightning_tpu.serve.spec import (SpecDecoder, _spec_paged_donated,
+                                          _spec_paged_plain,
+                                          _spec_rounds_donated,
+                                          _spec_rounds_plain)
 from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_LENGTH, FINISH_TIMEOUT,
                                              Request)
@@ -77,9 +99,9 @@ from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
 __all__ = ["ServeEngine", "KVSlotPool", "SlotPoolFull"]
 
 
-def _fold_rows(keys: jax.Array, data: jax.Array) -> jax.Array:
-    """Per-row ``fold_in``: (B, 2) raw uint32 keys x (B,) ints."""
-    return jax.vmap(jax.random.fold_in)(keys, data)
+# shared serve-program plumbing (one copy for engine + spec programs)
+_fold_rows = fold_rows
+_pick = pick_donated
 
 
 def _engine_step_core(model, params, cache, cur, pos, active, remaining,
@@ -118,7 +140,7 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
     """``steps`` decode steps in ONE dispatch (multi-step scheduling).
 
     Token-granularity dispatch pays the fixed per-call overhead once per
-    token — measured at ~55 ms on the axon tunnel vs a ~0.6 ms device
+    token — measured at ~108 ms on the axon tunnel vs a ~0.6 ms device
     step (docs/performance.md), which would hand the fused one-shot scan
     an unbeatable advantage. Scanning ``steps`` iterations of the SAME
     per-row step inside the program amortizes the dispatch 1/steps while
@@ -126,9 +148,16 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
     idempotently; emitted is −1-masked per sub-step). The trade is
     scheduling granularity: joins/retires happen every ``steps`` tokens.
 
+    ``cache`` may be int8 dense storage (a ``(q, s)`` tuple): the body
+    runs on the dequantized compute-dtype view and the result re-commits
+    through the same storage — both fused into this one dispatch.
+
     Returns the carried state plus ``emitted``/``finished`` stacked
     ``(steps, B)`` — the host replays sub-steps in order.
     """
+    storage = cache
+    cache = dense_storage_values(model, storage)
+
     def body(carry, _):
         cache, cur, pos, active, remaining, stepno = carry
         (cache, cur, pos, active, remaining, stepno, emitted,
@@ -141,6 +170,7 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
     (cache, cur, pos, active, remaining, stepno), (emitted, finished) = \
         jax.lax.scan(body, (cache, cur, pos, active, remaining, stepno),
                      None, length=steps)
+    cache = dense_storage_commit(model, storage, cache)
     return (cache, cur, pos, active, remaining, stepno, emitted, finished)
 
 
@@ -162,7 +192,12 @@ def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
     tokens — the sampled token then continues the request's key stream
     exactly where the dead engine left it (same array shapes, so replay
     reuses the compiled program).
+
+    ``pool_cache`` may be int8 dense storage (a ``(q, s)`` tuple): the
+    injection runs on the dequantized view and re-commits, fused.
     """
+    storage = pool_cache
+    pool_cache = dense_storage_values(model, storage)
     B_pf = prompts.shape[0]
     pf_cache, last = _prefill_impl(model, params, prompts, lengths)
     first_keys = _fold_rows(keys, startno)
@@ -195,69 +230,15 @@ def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
         return jnp.where(keep.reshape(mask_shape), pool, gathered)
 
     pool_cache = jax.tree_util.tree_map(inject, pool_cache, pf_cache)
-    return pool_cache, first
+    return dense_storage_commit(model, storage, pool_cache), first
 
 
 # --------------------------------------------------------------- paged
-def _page_axis(model) -> int:
-    # arena/cache leaves: (pages|B, seq, H, D) unrolled or
-    # (n_layers, pages|B, seq, H, D) scanned — page axis == batch axis
-    return 1 if model.cfg.scan_layers else 0
-
-
-def _arena_pages(model, arena) -> int:
-    axis = _page_axis(model)
-    return next(leaf.shape[axis]
-                for leaf in jax.tree_util.tree_leaves(arena)
-                if leaf.ndim >= 4)
-
-
-def _gather_pages(model, arena, page_table):
-    """Materialize the dense per-slot KV view from the arena: one gather
-    per KV leaf, ``(S, pp)`` page table → ``(S, pp * page_size, …)``
-    rows. Unmapped (−1) entries clamp to page 0 — finite stale bytes the
-    per-row attention mask never admits (every attended position lies in
-    a mapped page by construction) and the scatter never writes back."""
-    axis = _page_axis(model)
-    S, pp = page_table.shape
-    idx = jnp.maximum(page_table.reshape(-1), 0)
-
-    def gather(leaf):
-        if leaf.ndim < 4:
-            return leaf
-        pages = jnp.take(leaf, idx, axis=axis)
-        shape = list(pages.shape)
-        shape[axis:axis + 2] = [S, pp * shape[axis + 1]]
-        return pages.reshape(shape)
-
-    return jax.tree_util.tree_map(gather, arena)
-
-
-def _scatter_pages(model, arena, view, page_table):
-    """Write the dense view's rows back to their arena pages (inverse of
-    :func:`_gather_pages`). Unmapped entries scatter to a dropped
-    out-of-range index. Pages shared between slots (refcounted prefix
-    pages) receive identical values from every holder — nothing writes
-    inside an adopted page (decode and chunk writes land at positions
-    past the shared prefix) — so duplicate indices stay deterministic."""
-    axis = _page_axis(model)
-    num_pages = _arena_pages(model, arena)
-    S, pp = page_table.shape
-    pt = page_table.reshape(-1)
-    idx = jnp.where(pt >= 0, pt, num_pages)
-
-    def scatter(arena_leaf, view_leaf):
-        if arena_leaf.ndim < 4:
-            return arena_leaf
-        ps = arena_leaf.shape[axis + 1]
-        shape = list(view_leaf.shape)
-        shape[axis:axis + 2] = [S * pp, ps]
-        pages = view_leaf.reshape(shape)
-        if axis == 0:
-            return arena_leaf.at[idx].set(pages, mode="drop")
-        return arena_leaf.at[:, idx].set(pages, mode="drop")
-
-    return jax.tree_util.tree_map(scatter, arena, view)
+# the arena gather/scatter (and its int8 dequant/quant handling) lives
+# with the allocator in serve/pages.py — these aliases keep the program
+# impls below readable
+_gather_pages = gather_pages
+_scatter_pages = scatter_pages
 
 
 def _paged_step_impl(model, params, arena, page_table, cur, pos, active,
@@ -368,10 +349,6 @@ _chunk_prefill_plain = partial(
     jax.jit, static_argnames=("model",))(_chunk_prefill_impl)
 
 
-def _pick(donated, plain):
-    """Donate the pool cache wherever the backend honors it (same CPU
-    gating as generate()'s decode scan — CPU ignores donation loudly)."""
-    return plain if jax.default_backend() == "cpu" else donated
 
 
 class KVSlotPool:
@@ -386,11 +363,18 @@ class KVSlotPool:
     per-step keys would collide stream-for-stream).
     """
 
-    def __init__(self, model, num_slots: int):
+    def __init__(self, model, num_slots: int,
+                 kv_dtype: Optional[str] = None):
         self.num_slots = num_slots
-        self.cache = model.init(
+        self.kv_dtype = kv_dtype
+        cache = model.init(
             jax.random.PRNGKey(0), jnp.zeros((num_slots, 1), jnp.int32),
             positions=jnp.zeros((num_slots, 1), jnp.int32))["cache"]
+        if check_kv_dtype(kv_dtype):
+            # int8 storage: the (q, s) tuple the dense programs
+            # dequantize/re-quantize inside each dispatch
+            cache = quantize_dense_cache(model, cache)
+        self.cache = cache
         self._free: List[int] = list(range(num_slots))
         self._requests: Dict[int, Request] = {}  # slot -> request
 
@@ -459,6 +443,12 @@ class ServeEngine:
     shared-prompt KV pages (requires ``prefill_chunk`` — adopted chains
     resume at the first un-cached offset, which is a chunk dispatch).
 
+    Speculative decoding (``draft_model=``, ``draft_params=``,
+    ``spec_k=4``): ``step()`` runs fused spec rounds instead of decode
+    steps — see :mod:`ray_lightning_tpu.serve.spec` and
+    ``docs/serving.md``. ``kv_dtype="int8"`` halves at-rest KV bytes
+    on either storage layout (``docs/serving.md#int8-kv-storage``).
+
     Drive it with :class:`~ray_lightning_tpu.serve.client.ServeClient`
     (scheduler + admission control + clocks) or directly:
     ``prefill([reqs])`` to start requests (chunk-routed prompts advance
@@ -473,7 +463,10 @@ class ServeEngine:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 kv_dtype: Optional[str] = None,
+                 draft_model=None, draft_params=None,
+                 spec_k: Optional[int] = None):
         cfg = model.cfg
         if not cfg.decode:
             raise ValueError(
@@ -513,6 +506,14 @@ class ServeEngine:
                 "prefix_cache=True needs prefill_chunk= too: an adopted "
                 "prefix resumes prefill at its first un-cached offset, "
                 "which is a chunk-program dispatch")
+        if (spec_k is not None or draft_params is not None) \
+                and draft_model is None:
+            raise ValueError(
+                "spec_k / draft_params are speculative-decoding options: "
+                "pass draft_model= (a small decode-mode LM sharing the "
+                "target's vocab and max_seq_len) to enable them")
+        if draft_model is not None and draft_params is None:
+            raise ValueError("draft_model needs draft_params too")
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -543,12 +544,25 @@ class ServeEngine:
         # off by default; one attribute read + None check per dispatch
         # when disarmed (docs/observability.md)
         self._tel = telemetry
+        self.kv_dtype = kv_dtype
+        check_kv_dtype(kv_dtype)
         self.paged = page_size is not None
         if self.paged:
             self.pool = PagePool(model, num_slots, page_size,
-                                 num_pages=num_pages)
+                                 num_pages=num_pages, kv_dtype=kv_dtype)
         else:
-            self.pool = KVSlotPool(model, num_slots)
+            self.pool = KVSlotPool(model, num_slots, kv_dtype=kv_dtype)
+        # speculative decoding: draft proposals verified k+1 tokens per
+        # target dispatch (serve/spec.py); steps_per_dispatch scans spec
+        # ROUNDS instead of single decode steps when armed
+        if draft_model is not None:
+            self.spec_k = spec_k if spec_k is not None else 4
+            self.spec = SpecDecoder(draft_model, draft_params,
+                                    num_slots=num_slots, k=self.spec_k,
+                                    target_cfg=cfg)
+        else:
+            self.spec_k = None
+            self.spec = None
         if prefix_cache:
             self.prefix = PrefixCache(self.pool)
         else:
@@ -573,12 +587,19 @@ class ServeEngine:
         self._tokens: Dict[int, List[int]] = {}
 
         # counters for the bench / scheduler policy (steps counts
-        # dispatches; decode_substeps counts model token-steps)
+        # dispatches; decode_substeps counts target-model param-read
+        # passes: decode token-steps, or spec rounds — one verify reads
+        # the params once however many tokens it commits)
         self.steps = 0
         self.decode_substeps = 0
         self.prefills = 0
         self.chunk_dispatches = 0
         self.tokens_generated = 0
+        # speculative-decoding accounting (all zero on non-spec engines)
+        self.spec_rounds = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rejected_tokens = 0
+        self.spec_draft_steps = 0
 
     # ------------------------------------------------------------- state
     @property
@@ -631,6 +652,16 @@ class ServeEngine:
                 f"prompt ({request.prompt_len}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds max_seq_len "
                 f"({cfg.max_seq_len})")
+        if self.spec is not None and (request.prompt_len
+                                      + request.max_new_tokens
+                                      + self.spec_k - 1) > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({request.prompt_len}) + max_new_tokens "
+                f"({request.max_new_tokens}) needs spec_k-1 = "
+                f"{self.spec_k - 1} positions of verify headroom beyond "
+                f"it (the widened dispatch block-writes k draft "
+                f"positions past the last budgeted token) — "
+                f"max_seq_len ({cfg.max_seq_len}) is too small")
         if self.paged:
             need = self.pool.pages_needed(request)
             if need > self.pool.num_pages:
@@ -919,14 +950,25 @@ class ServeEngine:
         """Shared first-token bookkeeping for the batched prefill and the
         final chunk: record the token, retire on eos-on-first/exhausted
         budget, otherwise arm the slot's decode row."""
-        toks = list(req.replay_tokens or ()) + [tok]
+        toks = list(req.replay_tokens or ())
+        if self.spec is None or not toks:
+            toks.append(tok)
+            self.tokens_generated += 1
+        # else: spec-engine replay — the prefill's plain categorical
+        # draw is NOT the token the uninterrupted spec stream produced
+        # at this step (that one came through the rejection-resampling
+        # composition). Discard it and arm the row one step earlier:
+        # the next spec round regenerates step len(replay) through the
+        # same accept rule, off the same (seed, step) keys — sampled
+        # streams stay replay-exact (greedy is indifferent: both paths
+        # commit the target argmax). Non-spec engines keep the original
+        # contract: the prefill draw IS the stream's next token.
         self._tokens[slot] = toks
-        self.tokens_generated += 1
-        hit_eos = req.eos_id is not None and tok == req.eos_id
+        hit_eos = req.eos_id is not None and toks[-1] == req.eos_id
         if hit_eos or len(toks) >= req.max_new_tokens:
             return self._retire(
                 slot, FINISH_EOS if hit_eos else FINISH_LENGTH)
-        self._cur[slot, 0] = tok
+        self._cur[slot, 0] = toks[-1]
         self._pos[slot, 0] = req.prompt_len + len(toks) - 1
         self._active[slot] = True
         self._remaining[slot] = req.max_new_tokens - len(toks)
@@ -935,15 +977,27 @@ class ServeEngine:
         self._eos[slot] = -1 if req.eos_id is None else req.eos_id
         self._keys[slot] = key
         self._stepno[slot] = len(toks)
+        if self.spec is not None:
+            # whatever path armed the row (fresh admit, final chunk,
+            # crash replay), the draft KV must be rebuilt from the full
+            # context before the next spec dispatch
+            self.spec.mark_stale(slot)
         return None
 
     def step(self) -> List[Completion]:
         """Advance every in-flight request up to ``steps_per_dispatch``
         tokens in one program dispatch; returns the completions of rows
         that finished inside the block (eos or budget — rows finishing at
-        sub-step k park idempotently for the remaining sub-steps)."""
+        sub-step k park idempotently for the remaining sub-steps).
+
+        Speculative engines (``draft_model=``) route here too: each of
+        the ``steps_per_dispatch`` scanned units is then one spec ROUND
+        (k draft steps + one widened verify) committing 1..k+1 tokens
+        per row instead of exactly one."""
         if not self._active.any():
             return []
+        if self.spec is not None:
+            return self._spec_step()
         faults.fire("serve.dispatch")
         tel = self._tel
         with (tel.span("engine.step", active=int(self._active.sum()))
@@ -998,6 +1052,109 @@ class ServeEngine:
                       active=self.active_count, retired=len(done))
         return done
 
+    def _spec_step(self) -> List[Completion]:
+        """One speculative dispatch: refill stale draft rows, then run
+        ``steps_per_dispatch`` spec rounds (k+1 draft feeds + one
+        ``(B, k+1)`` verify each) in one fused program. Greedy commits
+        are token-identical to the plain step path by the accept rule
+        (see serve/spec.py); the host-side retire loop is shared
+        shape-for-shape with :meth:`step` at (rounds, k+1)-token
+        granularity."""
+        faults.fire("serve.dispatch")
+        spec = self.spec
+        active_req = self.pool.active
+        for slot in spec.stale:
+            req = active_req.get(slot)
+            if req is None or not self._active[slot]:
+                spec.discard(slot)
+                continue
+            # draft KV must cover 0..pos-1: full context minus the
+            # current token (which the first draft feed supplies)
+            spec.refill(slot, list(req.prompt) + self._tokens[slot][:-1])
+        faults.fire("serve.verify")
+        tel = self._tel
+        k, rounds = spec.k, self.steps_per_dispatch
+        with (tel.span("engine.spec_round", active=int(self._active.sum()),
+                       k=k) if tel is not None else NULL_SPAN):
+            if self.paged:
+                fn = _pick(_spec_paged_donated, _spec_paged_plain)
+                (self.pool.arena, spec.cache, cur, pos, active, remaining,
+                 stepno, emitted, accepted, rejected, finished) = fn(
+                    self.model, spec.model, self.params, spec.params,
+                    self.pool.arena, np.array(self.pool.page_table),
+                    spec.cache, self._cur, self._pos, self._active,
+                    self._remaining, self._temp, self._top_k, self._eos,
+                    self._keys, self._stepno, k=k, rounds=rounds)
+            else:
+                fn = _pick(_spec_rounds_donated, _spec_rounds_plain)
+                (self.pool.cache, spec.cache, cur, pos, active, remaining,
+                 stepno, emitted, accepted, rejected, finished) = fn(
+                    self.model, spec.model, self.params, spec.params,
+                    self.pool.cache, spec.cache, self._cur, self._pos,
+                    self._active, self._remaining, self._temp,
+                    self._top_k, self._eos, self._keys, self._stepno,
+                    k=k, rounds=rounds)
+        self._cur = np.array(cur)
+        self._pos = np.array(pos)
+        self._active = np.array(active)
+        self._remaining = np.array(remaining)
+        self._stepno = np.array(stepno)
+        emitted = np.asarray(emitted)     # (rounds, B, k+1), −1 = none
+        accepted = np.asarray(accepted)   # (rounds, B) draft credits
+        rejected = np.asarray(rejected)   # (rounds, B) real divergences
+        finished = np.asarray(finished)   # (rounds, B)
+
+        done: List[Completion] = []
+        committed = 0
+        for slot in range(self.num_slots):
+            toks = [int(t) for t in emitted[:, slot, :].reshape(-1)
+                    if t >= 0]
+            if not toks:
+                continue
+            self._tokens[slot].extend(toks)
+            committed += len(toks)
+            self.tokens_generated += len(toks)
+            if finished[:, slot].any():
+                req = self.pool.active[slot]
+                hit_eos = req.eos_id is not None and toks[-1] == req.eos_id
+                done.append(self._retire(
+                    slot, FINISH_EOS if hit_eos else FINISH_LENGTH))
+        acc_total = int(accepted.sum())
+        rej_total = int(rejected.sum())
+        # judged = drafts the verify actually ruled on in the committed
+        # stream (accepted + contradicted); agreements cut by a
+        # budget/eos clamp count toward neither side, so the rate reads
+        # the draft's true quality — 1.0 for a perfectly-agreeing draft
+        # even on its final, budget-clamped round
+        judged = acc_total + rej_total
+        self.steps += 1
+        # one verify = one target param read, however many tokens it
+        # committed — the honesty-floor unit stays "target passes"
+        self.decode_substeps += rounds
+        self.spec_rounds += rounds
+        self.spec_draft_steps += (k + 1) * rounds
+        self.spec_accepted_tokens += acc_total
+        self.spec_rejected_tokens += rej_total
+        if tel is not None:
+            tel.event("engine.spec_round", dispatch=self.steps,
+                      rounds=rounds, judged=judged,
+                      accepted=acc_total, committed=committed,
+                      retired=len(done))
+            m = tel.metrics
+            m.counter("serve_spec_accepted_tokens_total",
+                      help="draft tokens accepted by the verify step"
+                      ).inc(acc_total)
+            m.counter("serve_spec_rejected_tokens_total",
+                      help="draft tokens contradicted by the verify "
+                      "step").inc(rej_total)
+            if judged:
+                m.histogram(
+                    "serve_spec_accept_rate",
+                    help="per-dispatch draft acceptance rate "
+                    "(accepted / judged)"
+                ).observe(acc_total / judged)
+        return done
+
     # -------------------------------------------------------- lifecycle
     def snapshot_in_flight(self) -> List:
         """``[(request, tokens_emitted_so_far)]`` for every in-flight
@@ -1031,6 +1188,9 @@ class ServeEngine:
             self.prefix.drop()
         self.prefix = None
         self.pool = None
+        if self.spec is not None:
+            self.spec.shutdown()
+        self.spec = None
         self._chunk_queue.clear()
         self._tokens.clear()
         self._active[:] = False
@@ -1043,6 +1203,10 @@ class ServeEngine:
                 st for st in self._chunk_queue if st.slot != slot)
         req = self.pool.release(slot)
         self._active[slot] = False
+        if self.spec is not None:
+            # a cancel between activation and the next spec dispatch
+            # must not refill a slot that no longer holds the request
+            self.spec.discard(slot)
         # a mid-chunking REPLAY has no _tokens entry yet: its pre-crash
         # emissions live in replay_tokens and a cancel/deadline must
         # still surface them (PR 3's partial-tokens contract)

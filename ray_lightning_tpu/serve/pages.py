@@ -40,6 +40,226 @@ import numpy as np
 
 from ray_lightning_tpu.serve.request import OccupancyError
 
+#: accepted ``kv_dtype`` spellings: None/"bf16" = store KV at the model's
+#: compute dtype (the default, byte-identical to the pre-quantization
+#: engines); "int8" = absmax-scaled int8 storage with f32 scales in a
+#: parallel leaf (LLM.int8-style storage-only quantization: compute
+#: stays at cfg.dtype, only the at-rest arena bytes halve)
+KV_DTYPE_INT8 = "int8"
+
+
+def check_kv_dtype(kv_dtype) -> bool:
+    """Normalize/validate a ``kv_dtype`` option; returns True for the
+    quantized path."""
+    if kv_dtype in (None, "bf16"):
+        return False
+    if kv_dtype == KV_DTYPE_INT8:
+        return True
+    raise ValueError(
+        f"kv_dtype must be None, 'bf16' or 'int8', got {kv_dtype!r}")
+
+
+# ---------------------------------------------------------------- int8 KV
+# Quantized KV storage is a 2-tuple ``(q_tree, s_tree)`` with the SAME
+# pytree structure as the plain cache: KV leaves (ndim >= 4) hold int8
+# codes in ``q_tree`` and f32 absmax scales (keepdims, reduced axes per
+# granularity) in ``s_tree``; sub-4d bookkeeping leaves (cache_index)
+# live unchanged in ``q_tree`` with a zero-size placeholder in
+# ``s_tree``. The tuple flows through the jitted programs as an
+# ordinary pytree — dequantize on the way in, re-quantize on the way
+# out, both fused into the dispatch.
+
+def kv_scales(values: jax.Array, reduce_axes: Tuple[int, ...]) -> jax.Array:
+    """Absmax scales over ``reduce_axes`` (keepdims), guarded so an
+    all-zero group dequantizes to exact zeros instead of NaN."""
+    amax = jnp.max(jnp.abs(values.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+    return jnp.where(amax > 0, amax / 127.0, 1.0)
+
+
+def kv_quantize(values: jax.Array, scales: jax.Array) -> jax.Array:
+    return jnp.clip(jnp.round(values.astype(jnp.float32) / scales),
+                    -127, 127).astype(jnp.int8)
+
+
+def kv_dequantize(q: jax.Array, scales: jax.Array, dtype) -> jax.Array:
+    return (q.astype(jnp.float32) * scales).astype(dtype)
+
+
+def _dense_reduce_axes(leaf) -> Tuple[int, ...]:
+    # dense pool granularity: per (slot, position, head) — reduce the
+    # head_dim axis only (finest practical: scales add ~1/(2*D) bytes)
+    return (leaf.ndim - 1,)
+
+
+def quantize_dense_cache(model, values):
+    """Plain dense cache tree → the ``(q, s)`` storage tuple
+    (per-position-per-head scales)."""
+    def q_leaf(leaf):
+        if leaf.ndim < 4:
+            return leaf
+        return kv_quantize(leaf, kv_scales(leaf, _dense_reduce_axes(leaf)))
+
+    def s_leaf(leaf):
+        if leaf.ndim < 4:
+            return jnp.zeros((), jnp.float32)
+        return kv_scales(leaf, _dense_reduce_axes(leaf))
+
+    tm = jax.tree_util.tree_map
+    return tm(q_leaf, values), tm(s_leaf, values)
+
+
+def dense_storage_values(model, storage):
+    """Materialize compute-dtype KV values from dense storage: identity
+    for plain storage, dequantize for the ``(q, s)`` int8 tuple (the
+    bookkeeping leaves pass through from ``q``)."""
+    if not isinstance(storage, tuple):
+        return storage
+    q, s = storage
+    dt = model.cfg.dtype
+    return jax.tree_util.tree_map(
+        lambda ql, sl: ql if ql.ndim < 4 else kv_dequantize(ql, sl, dt),
+        q, s)
+
+
+def dense_storage_commit(model, storage, values):
+    """Write updated compute-dtype values back into dense storage:
+    identity for plain storage, re-quantize for int8 (untouched rows
+    round-trip idempotently: absmax codes saturate at exactly 127, so
+    re-quantizing a dequantized group reproduces the same codes and
+    scales — parked rows stay frozen through any number of dispatches)."""
+    if not isinstance(storage, tuple):
+        return values
+    q, s = storage
+
+    def commit_q(ql, vl):
+        if ql.ndim < 4:
+            return vl   # updated bookkeeping lives in the q tree
+        return kv_quantize(vl, kv_scales(vl, _dense_reduce_axes(vl)))
+
+    def commit_s(sl, vl):
+        if vl.ndim < 4:
+            return sl
+        return kv_scales(vl, _dense_reduce_axes(vl))
+
+    tm = jax.tree_util.tree_map
+    return tm(commit_q, q, values), tm(commit_s, s, values)
+
+
+# --------------------------------------------------- arena gather/scatter
+def page_axis(model) -> int:
+    """Arena/cache leaves are ``(pages|B, seq, H, D)`` unrolled or
+    ``(n_layers, pages|B, seq, H, D)`` scanned — page axis == batch
+    axis."""
+    return 1 if model.cfg.scan_layers else 0
+
+
+def arena_num_pages(model, arena) -> int:
+    axis = page_axis(model)
+    tree = arena[0] if isinstance(arena, tuple) else arena
+    return next(leaf.shape[axis]
+                for leaf in jax.tree_util.tree_leaves(tree)
+                if leaf.ndim >= 4)
+
+
+def _page_reduce_axes(axis: int, leaf) -> Tuple[int, ...]:
+    # paged granularity: per (page, head) — reduce page_size and
+    # head_dim; scales leaf is (…, P, 1, H, 1)
+    return (axis + 1, axis + 3)
+
+
+def gather_pages(model, arena, page_table):
+    """Materialize the dense per-slot KV view from the arena: one gather
+    per KV leaf, ``(S, pp)`` page table → ``(S, pp * page_size, …)``
+    rows. Unmapped (−1) entries clamp to page 0 — finite stale bytes the
+    per-row attention mask never admits (every attended position lies in
+    a mapped page by construction) and the scatter never writes back.
+    Int8 arenas dequantize inside the gather (page codes × page scales →
+    compute dtype), so every program downstream sees the same
+    compute-dtype view either way."""
+    axis = page_axis(model)
+    S, pp = page_table.shape
+    idx = jnp.maximum(page_table.reshape(-1), 0)
+
+    def to_view(pages):
+        shape = list(pages.shape)
+        shape[axis:axis + 2] = [S, pp * shape[axis + 1]]
+        return pages.reshape(shape)
+
+    if not isinstance(arena, tuple):
+        def gather(leaf):
+            if leaf.ndim < 4:
+                return leaf
+            return to_view(jnp.take(leaf, idx, axis=axis))
+
+        return jax.tree_util.tree_map(gather, arena)
+
+    q, s = arena
+    dt = model.cfg.dtype
+
+    def gather_q(ql, sl):
+        if ql.ndim < 4:
+            return ql
+        pages = kv_dequantize(jnp.take(ql, idx, axis=axis),
+                              jnp.take(sl, idx, axis=axis), dt)
+        return to_view(pages)
+
+    return jax.tree_util.tree_map(gather_q, q, s)
+
+
+def scatter_pages(model, arena, view, page_table):
+    """Write the dense view's rows back to their arena pages (inverse of
+    :func:`gather_pages`). Unmapped entries scatter to a dropped
+    out-of-range index. Pages shared between slots (refcounted prefix
+    pages) receive identical values from every holder — nothing writes
+    inside an adopted page (decode and chunk writes land at positions
+    past the shared prefix) — so duplicate indices stay deterministic.
+    Int8 arenas quantize inside the scatter: per-page-per-head absmax
+    scales recomputed from the view's pages (untouched pages round-trip
+    idempotently, same saturation argument as the dense commit)."""
+    axis = page_axis(model)
+    num_pages = arena_num_pages(model, arena)
+    S, pp = page_table.shape
+    pt = page_table.reshape(-1)
+    idx = jnp.where(pt >= 0, pt, num_pages)
+
+    def to_pages(arena_leaf, view_leaf):
+        ps = arena_leaf.shape[axis + 1]
+        shape = list(view_leaf.shape)
+        shape[axis:axis + 2] = [S * pp, ps]
+        return view_leaf.reshape(shape)
+
+    def write(arena_leaf, pages):
+        if axis == 0:
+            return arena_leaf.at[idx].set(pages, mode="drop")
+        return arena_leaf.at[:, idx].set(pages, mode="drop")
+
+    if not isinstance(arena, tuple):
+        def scatter(arena_leaf, view_leaf):
+            if arena_leaf.ndim < 4:
+                return arena_leaf
+            return write(arena_leaf, to_pages(arena_leaf, view_leaf))
+
+        return jax.tree_util.tree_map(scatter, arena, view)
+
+    q, s = arena
+
+    def scatter_q(ql, sl, vl):
+        if ql.ndim < 4:
+            return ql
+        pages = to_pages(ql, vl)
+        return write(ql, kv_quantize(
+            pages, kv_scales(pages, _page_reduce_axes(axis, pages))))
+
+    def scatter_s(ql, sl, vl):
+        if ql.ndim < 4:
+            return sl
+        pages = to_pages(ql, vl)
+        return write(sl, kv_scales(pages, _page_reduce_axes(axis, pages)))
+
+    tm = jax.tree_util.tree_map
+    return tm(scatter_q, q, s, view), tm(scatter_s, q, s, view)
+
 
 class SlotPoolFull(OccupancyError):
     """No free KV slot (or, paged, not enough free pages) — admission
@@ -59,6 +279,20 @@ class SlotPoolFull(OccupancyError):
         super().__init__(message, slots_free=slots_free,
                          pages_free=pages_free, pages_needed=pages_needed,
                          active=active)
+
+
+def fold_rows(keys: jax.Array, data: jax.Array) -> jax.Array:
+    """Per-row ``fold_in``: (B, 2) raw uint32 keys x (B,) ints — the key
+    plumbing every serve program shares (engine step, prefill inject,
+    spec rounds)."""
+    return jax.vmap(jax.random.fold_in)(keys, data)
+
+
+def pick_donated(donated, plain):
+    """Donate device buffers wherever the backend honors it — the CPU
+    backend ignores donation loudly, so tests stay quiet on the plain
+    variant (one gating policy for every serve program)."""
+    return plain if jax.default_backend() == "cpu" else donated
 
 
 def check_seed_free(active_requests: Dict[int, "Request"],
@@ -94,7 +328,8 @@ class PagePool:
     """
 
     def __init__(self, model, num_slots: int, page_size: int,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
         cfg = model.cfg
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -104,6 +339,8 @@ class PagePool:
                 f"({cfg.max_seq_len}) — the page table tiles the whole "
                 "sequence axis")
         self._model = model
+        self.kv_dtype = kv_dtype
+        self._quantized = check_kv_dtype(kv_dtype)
         self.page_size = page_size
         self.pages_per_slot = cfg.max_seq_len // page_size
         self.num_pages = (num_pages if num_pages is not None
@@ -122,29 +359,87 @@ class PagePool:
         self._span: Dict[int, int] = {}             # slot -> mapped pages
 
     # ------------------------------------------------------------- arena
+    def _arena_template(self, shapes_only: bool = False):
+        """The plain (unquantized) arena pytree — materialized, or as
+        ShapeDtypeStructs when ``shapes_only`` (the byte-accounting
+        probe must never allocate device memory)."""
+        model = self._model
+        run = jax.eval_shape if shapes_only else (
+            lambda f, *a, **kw: f(*a, **kw))
+        init = run(model.init, jax.random.PRNGKey(0),
+                   jnp.zeros((1, 1), jnp.int32),
+                   positions=jnp.zeros((1, 1), jnp.int32))
+        template = init["cache"]
+        axis = page_axis(model)
+
+        def to_arena(leaf):
+            if leaf.ndim < 4:
+                return leaf
+            shape = list(leaf.shape)
+            shape[axis] = self.num_pages
+            shape[axis + 1] = self.page_size
+            if shapes_only:
+                return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
+            return jnp.zeros(shape, leaf.dtype)
+
+        return jax.tree_util.tree_map(to_arena, template)
+
     @property
     def arena(self):
         if self._arena is None:
-            model = self._model
-            template = model.init(
-                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
-                positions=jnp.zeros((1, 1), jnp.int32))["cache"]
-            axis = 1 if model.cfg.scan_layers else 0
+            plain = self._arena_template()
+            if self._quantized:
+                axis = page_axis(self._model)
 
-            def to_arena(leaf):
-                if leaf.ndim < 4:
-                    return leaf
-                shape = list(leaf.shape)
-                shape[axis] = self.num_pages
-                shape[axis + 1] = self.page_size
-                return jnp.zeros(shape, leaf.dtype)
+                def q_leaf(leaf):
+                    if leaf.ndim < 4:
+                        return leaf
+                    return jnp.zeros(leaf.shape, jnp.int8)
 
-            self._arena = jax.tree_util.tree_map(to_arena, template)
+                def s_leaf(leaf):
+                    if leaf.ndim < 4:
+                        return jnp.zeros((), jnp.float32)
+                    shape = list(leaf.shape)
+                    for ax in _page_reduce_axes(axis, leaf):
+                        shape[ax] = 1
+                    return jnp.ones(shape, jnp.float32)
+
+                tm = jax.tree_util.tree_map
+                self._arena = (tm(q_leaf, plain), tm(s_leaf, plain))
+            else:
+                self._arena = plain
         return self._arena
 
     @arena.setter
     def arena(self, value):
         self._arena = value
+
+    @property
+    def bytes_per_page(self) -> int:
+        """At-rest bytes one arena page costs across every KV leaf
+        (int8: codes + the per-page-per-head f32 scales). Computed from
+        shapes only — pure accounting callers (the equal-byte capacity
+        bench/tests) never allocate the arena."""
+        axis = page_axis(self._model)
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(
+                self._arena_template(shapes_only=True)):
+            if leaf.ndim < 4:
+                continue
+            numel = 1
+            for d, n in enumerate(leaf.shape):
+                if d != axis:
+                    numel *= n
+            if self._quantized:
+                scale_numel = 1
+                reduced = _page_reduce_axes(axis, leaf)
+                for d, n in enumerate(leaf.shape):
+                    if d != axis and d not in reduced:
+                        scale_numel *= n
+                total += numel + scale_numel * 4
+            else:
+                total += numel * jnp.dtype(leaf.dtype).itemsize
+        return total
 
     # -------------------------------------------------------- accounting
     @property

@@ -79,6 +79,10 @@ def pytest_configure(config):
         "markers", "fleet: the replica-fleet serving tier (router/"
         "supervision/failover/autoscaler) — `pytest -m fleet` runs it as "
         "a fast targeted subset")
+    config.addinivalue_line(
+        "markers", "spec: speculative decoding + int8 KV quantization "
+        "(draft/verify programs, acceptance rules, quantized storage) — "
+        "`pytest -m spec` runs it as a fast targeted subset")
 
 
 @pytest.fixture(autouse=True)

@@ -39,6 +39,10 @@ RESOURCE_FACTORIES = {
     # (optionally) a standby pool; a Router owns the affinity/EWMA maps
     # that must not outlive their replicas — both release in shutdown()
     "ReplicaFleet", "Router",
+    # speculative decoding: a SpecDecoder owns the draft model's dense
+    # KV cache (device memory) — released via the owning engine's
+    # shutdown()
+    "SpecDecoder",
 }
 
 RELEASE_METHODS = {"shutdown", "close", "_kill", "kill"}
