@@ -835,6 +835,18 @@ def _bench_decode(batch: int = 8, prompt: int = 16,
     }
 
 
+def _param_stream_floor_s(params) -> float:
+    """Seconds one param-streaming pass cannot beat: the engine's
+    at-rest parameter bytes (``models/quant.py param_bytes`` — exact
+    for plain AND weight-quantized trees) over 1.5x the device's HBM
+    bandwidth. The shared denominator of every serve honesty floor."""
+    import jax
+
+    from ray_lightning_tpu.models.quant import param_bytes
+
+    return param_bytes(params) / (1.5 * _hbm_bandwidth(jax.devices()[0]))
+
+
 def _bench_serve(num_slots: int = 8, n_requests: int = 16,
                  prompt: int = 64, new_tokens: int = 64,
                  spread: float = 1.5,
@@ -952,10 +964,13 @@ def _bench_serve(num_slots: int = 8, n_requests: int = 16,
             f"{useful_tokens}")
 
     # honesty floor (same contract as _bench_decode): every model
-    # token-step reads all params once, so the busy time cannot beat the
-    # bf16 param bytes over HBM x the number of executed sub-steps
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    step_floor = (2 * n_params) / (1.5 * _hbm_bandwidth(jax.devices()[0]))
+    # token-step reads all at-rest param bytes once, so the busy time
+    # cannot beat those bytes over HBM x the number of executed
+    # sub-steps. Bytes come from param_bytes() — the exact storage
+    # accounting — NOT dtype arithmetic: a weight-quantized engine's
+    # floor must shrink with its codes (stale 2*n_params math would
+    # hand quantized legs a floor they could legitimately beat)
+    step_floor = _param_stream_floor_s(client.engine.params)
     substeps = (client.engine.decode_substeps + client.engine.prefills)
     if makespan < max(substeps * step_floor,
                       1000 * time.get_clock_info("perf_counter").resolution):
@@ -1427,6 +1442,287 @@ def _bench_kv_int8(num_slots: int = 8, prompt: int = 64,
             big, num_slots=1, page_size=page_size, num_pages=1,
             kv_dtype="int8").bytes_per_page,
         "int8_token_mismatches": mism,
+    }
+
+
+def _bench_weight_quant(num_slots: int = 2, n_requests: int = 6,
+                        prompt: int = 16, new_tokens: int = 32,
+                        steps_per_dispatch: int = 4) -> dict:
+    """Weight-only int8/int4 quantization A/B on the pinned
+    bandwidth-bound shape (the 8L/d512 f32 target of ``_bench_spec`` —
+    ~103 MB of params, well past cache, so a decode step's cost IS the
+    param stream).
+
+    Three sequential legs (fp32, int8, int4), each warmed and run
+    alone, clients released. ENFORCED gates (``MeasurementError``):
+
+    - **param bytes** via ``param_bytes()`` (exact codes+scales
+      accounting, never dtype arithmetic): int8 <= 0.55x fp, int4
+      <= 0.35x fp. These are the bytes the honesty floor charges the
+      quantized legs — the floor shrinks with the codes.
+    - **top-1 agreement** vs the fp leg, teacher-forced: the quantized
+      model re-scores the fp leg's exact streams position-by-position
+      (prompt + fp tokens in, argmax out), so one early flip cannot
+      cascade — the honest "weight quant perturbs logits" metric.
+      int8 >= 0.95, int4 >= 0.60 (measured 0.99 / 0.74 on this
+      UNTRAINED random net — trained weights agree far more; token
+      identity is deliberately NOT the gate, unlike int8 KV / spec /
+      page-native which are exact by construction).
+    - each leg emits the full token budget (no lost tokens).
+
+    Decode throughput per leg is RECORDED, not gated: on this CPU host
+    XLA materializes the dequantized f32 tree once per dispatch (no
+    convert-into-GEMM fusion on the oneDNN path), so quantized decode
+    honestly LOSES wall-clock here (~0.4x measured) — the same
+    host-regime honesty note as ``_bench_spec``'s cache-resident
+    caveat. The tracked claim is the byte stream (floor-backed); the
+    wall-clock win requires a backend that feeds codes to the MXU/GEMM
+    without a materialized temp (TPU convert fusion, or the pallas
+    endgame in ``docs/serving.md``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.models.quant import (dequantize_params,
+                                                param_bytes)
+    from ray_lightning_tpu.serve import ServeClient
+
+    max_len = prompt + new_tokens
+    base = dict(vocab_size=1024, max_seq_len=max_len,
+                dtype=jnp.float32, scan_layers=False, d_model=512,
+                n_heads=8, d_ff=2048, n_layers=8)
+    tcfg = gpt2_config("nano", decode=True, **base)
+    dec = TransformerLM(tcfg)
+    params = jax.device_get(TransformerLM(
+        gpt2_config("nano", **base)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 8), np.int32))["params"])
+
+    rng = np.random.default_rng(5)
+    trace = []
+    for _ in range(n_requests):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        trace.append((0.0, dict(
+            prompt=[int(t) for t in rng.integers(0, 1024, size=L)],
+            max_new_tokens=int(rng.integers(new_tokens // 2,
+                                            new_tokens + 1)))))
+    useful = sum(t[1]["max_new_tokens"] for t in trace)
+
+    def leg(weight_dtype):
+        kw = dict(num_slots=num_slots, prefill_len=prompt + new_tokens,
+                  steps_per_dispatch=steps_per_dispatch,
+                  clock=time.perf_counter, weight_dtype=weight_dtype)
+        warm = ServeClient(dec, params, **kw)
+        for i in range(2):
+            warm.submit(trace[i][1]["prompt"], max_new_tokens=2)
+        warm.run_until_idle()
+        warm.shutdown()
+        client = ServeClient(dec, params, **kw)
+        out = client.serve_trace(list(trace))
+        makespan = max(c.finish_time for c in out.values())
+        if sum(len(c.tokens) for c in out.values()) != useful:
+            raise MeasurementError(
+                f"{weight_dtype or 'fp'} leg lost tokens")
+        # the floor each leg must respect charges ITS at-rest bytes
+        floor = _param_stream_floor_s(client.engine.params)
+        substeps = client.engine.decode_substeps + client.engine.prefills
+        if makespan < substeps * floor:
+            raise MeasurementError(
+                f"{weight_dtype or 'fp'} leg beat its own "
+                "param-bandwidth floor — work elided")
+        stored = client.engine.params
+        client.shutdown()
+        return out, makespan, stored
+
+    # sequential A/B/C, each leg alone (this host jitters +-10%)
+    out_fp, mk_fp, p_fp = leg(None)
+    out_i8, mk_i8, p_i8 = leg("int8")
+    out_i4, mk_i4, p_i4 = leg("int4")
+
+    bytes_fp = param_bytes(p_fp)
+    ratio_i8 = param_bytes(p_i8) / bytes_fp
+    ratio_i4 = param_bytes(p_i4) / bytes_fp
+    if ratio_i8 > 0.55 or ratio_i4 > 0.35:
+        raise MeasurementError(
+            f"weight-quant byte accounting regressed: int8 {ratio_i8:.3f}x "
+            f"(must be <= 0.55), int4 {ratio_i4:.3f}x (<= 0.35)")
+
+    # teacher-forced top-1 agreement: re-score the fp streams with the
+    # quantized weights; every position conditions on the SAME (fp)
+    # context, so agreement reads per-position flip probability
+    cache0 = dec.init(jax.random.PRNGKey(0),
+                      np.zeros((1, 1), np.int32),
+                      positions=np.zeros((1, 1), np.int32))["cache"]
+
+    def agreement(stored):
+        deq = dequantize_params(stored)
+        agree = total = 0
+        for comp in out_fp.values():
+            seq = list(comp.prompt) + list(comp.tokens)
+            L = len(seq)
+            batch = np.asarray(seq, np.int32)[None, :]
+            logits, _ = dec.apply(
+                {"params": deq, "cache": cache0}, jnp.asarray(batch),
+                positions=jnp.arange(L)[None, :], deterministic=True,
+                mutable=["cache"])
+            pred = np.asarray(logits[0]).argmax(-1)[
+                len(comp.prompt) - 1:L - 1]
+            ref = np.asarray(comp.tokens)
+            agree += int((pred == ref).sum())
+            total += len(ref)
+        return agree / total
+
+    agree_i8 = agreement(p_i8)
+    agree_i4 = agreement(p_i4)
+    if agree_i8 < 0.95 or agree_i4 < 0.60:
+        raise MeasurementError(
+            f"weight-quant top-1 agreement collapsed: int8 "
+            f"{agree_i8:.3f} (>= 0.95), int4 {agree_i4:.3f} (>= 0.60) "
+            "— quantization is corrupting weights beyond rounding")
+
+    return {
+        "model": "8L/d512/v1024 f32 target (the _bench_spec "
+                 "bandwidth-bound shape)",
+        "num_slots": num_slots, "requests": n_requests,
+        "useful_tokens": useful,
+        "steps_per_dispatch": steps_per_dispatch,
+        "param_bytes_fp": bytes_fp,
+        "param_bytes_int8": param_bytes(p_i8),
+        "param_bytes_int4": param_bytes(p_i4),
+        "param_bytes_int8_vs_fp": round(ratio_i8, 3),
+        "param_bytes_int4_vs_fp": round(ratio_i4, 3),
+        "top1_agreement_int8": round(agree_i8, 4),
+        "top1_agreement_int4": round(agree_i4, 4),
+        "fp_tokens_per_sec": round(useful / mk_fp, 1),
+        "int8_tokens_per_sec": round(useful / mk_i8, 1),
+        "int4_tokens_per_sec": round(useful / mk_i4, 1),
+        "int8_vs_fp_decode": round(mk_fp / mk_i8, 2),
+        "int4_vs_fp_decode": round(mk_fp / mk_i4, 2),
+        "note": "byte + agreement gates ENFORCED; decode ratios "
+                "recorded honestly — this CPU host materializes the "
+                "per-dispatch dequant (no convert-into-GEMM fusion), "
+                "so quantized decode loses wall-clock here; the byte "
+                "stream is the floor-backed claim "
+                "(docs/performance.md round 11)",
+    }
+
+
+def _bench_page_native(num_slots: int = 8, prompt: int = 32,
+                       new_tokens: int = 32, page_size: int = 64,
+                       max_seq_len: int = 512,
+                       steps_per_dispatch: int = 4) -> dict:
+    """Page-native attention vs dense-gather on a pinned KV-dominated
+    shape: both engines serve the SAME trace on identical page arenas;
+    the only difference is whether each decode dispatch materializes
+    the dense ``(num_slots, max_seq_len)`` KV view (gather → step →
+    scatter) or reads/writes K/V straight through the page table
+    inside the attention.
+
+    The shape pins the regime the lever targets: 8 slots x 512
+    positions x 8 layers of d512 f32 KV = a ~134 MB view per dispatch
+    against ~16 MB of actually-occupied pages (the trace's requests
+    hold 1 page each → <= 25% arena occupancy, asserted from the same
+    ``bytes_per_page`` accounting the capacity benches use — never
+    dtype arithmetic). ENFORCED: ``page_native_token_mismatches`` == 0
+    (the path is exact — same scores, same masks, one exact softmax;
+    only final-accumulation rounding differs, below these f32 argmax
+    margins) and speedup >= 1.2x (measured ~3x on this host; the win
+    scales with 1/occupancy).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.serve import ServeClient
+
+    base = dict(vocab_size=1024, max_seq_len=max_seq_len,
+                dtype=jnp.float32, scan_layers=False, d_model=512,
+                n_heads=8, d_ff=2048, n_layers=8)
+    tcfg = gpt2_config("nano", decode=True, **base)
+    dec = TransformerLM(tcfg)
+    params = jax.device_get(TransformerLM(
+        gpt2_config("nano", **base)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 8), np.int32))["params"])
+
+    rng = np.random.default_rng(5)
+    trace = []
+    pages_needed = 0
+    for _ in range(num_slots):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        budget = int(rng.integers(new_tokens // 2, new_tokens + 1))
+        trace.append((0.0, dict(
+            prompt=[int(t) for t in rng.integers(0, 1024, size=L)],
+            max_new_tokens=budget)))
+        pages_needed += -(-(L + budget) // page_size)
+    useful = sum(t[1]["max_new_tokens"] for t in trace)
+
+    def leg(page_native):
+        kw = dict(num_slots=num_slots, prefill_len=prompt,
+                  page_size=page_size,
+                  steps_per_dispatch=steps_per_dispatch,
+                  clock=time.perf_counter, page_native=page_native)
+        warm = ServeClient(dec, params, **kw)
+        for i in range(2):
+            warm.submit(trace[i][1]["prompt"], max_new_tokens=2)
+        warm.run_until_idle()
+        warm.shutdown()
+        client = ServeClient(dec, params, **kw)
+        out = client.serve_trace(list(trace))
+        makespan = max(c.finish_time for c in out.values())
+        if sum(len(c.tokens) for c in out.values()) != useful:
+            raise MeasurementError(
+                f"page_native={page_native} leg lost tokens")
+        pool = client.engine.pool
+        bpp = pool.bytes_per_page
+        pages_per_slot = pool.pages_per_slot
+        total_pages = pool.num_pages
+        client.shutdown()
+        return out, makespan, bpp, pages_per_slot, total_pages
+
+    # sequential A/B, each leg warmed and run alone
+    out_d, mk_d, bpp, pages_per_slot, total_pages = leg(False)
+    out_n, mk_n, _, _, _ = leg(True)
+
+    occupancy = pages_needed / total_pages
+    if occupancy > 0.25:
+        raise MeasurementError(
+            f"page-native pin broken: trace occupies {occupancy:.2f} of "
+            "the arena (the claim is gated at <= 0.25 — at high "
+            "occupancy the dense view approaches the occupied bytes "
+            "and the lever flattens by design)")
+    mismatches = sum(1 for rid in out_d
+                     if out_n[rid].tokens != out_d[rid].tokens)
+    if mismatches:
+        raise MeasurementError(
+            f"page-native flipped {mismatches}/{num_slots} greedy "
+            "streams vs dense-gather (f32: no rounding excuse) — the "
+            "page-table read/write path is broken")
+    speedup = mk_d / mk_n
+    if speedup < 1.2:
+        raise MeasurementError(
+            f"page-native decode only {speedup:.2f}x dense-gather at "
+            f"{occupancy:.2f} occupancy — the dense-view bytes are not "
+            "being skipped")
+
+    return {
+        "model": "8L/d512/v1024 f32, max_seq_len=512 (KV-dominated)",
+        "num_slots": num_slots, "page_size": page_size,
+        "steps_per_dispatch": steps_per_dispatch,
+        "useful_tokens": useful,
+        "arena_occupancy": round(occupancy, 3),
+        # byte claims from bytes_per_page accounting, not dtype math
+        "dense_view_bytes_per_dispatch": num_slots * pages_per_slot
+        * bpp,
+        "occupied_page_bytes": pages_needed * bpp,
+        "dense_gather_tokens_per_sec": round(useful / mk_d, 1),
+        "page_native_tokens_per_sec": round(useful / mk_n, 1),
+        "page_native_vs_dense_gather": round(speedup, 2),
+        "page_native_token_mismatches": mismatches,
+        "note": "exact page-table-direct attention (no per-dispatch "
+                "dense view); bytes touched scale with occupied pages "
+                "— the win grows as occupancy falls",
     }
 
 
@@ -2431,6 +2727,25 @@ def main() -> None:
             extras["serve"]["kv_int8"] = _bench_kv_int8()
     except Exception as exc:
         extras["serve"]["kv_int8"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # weight-only int8/int4 quantization: param-byte ratios and
+        # teacher-forced top-1 agreement ENFORCED; decode ratios
+        # recorded with the host-regime honesty note (untracked)
+        if isinstance(extras.get("serve"), dict) \
+                and "error" not in extras["serve"]:
+            extras["serve"]["weight_quant"] = _bench_weight_quant()
+    except Exception as exc:
+        extras["serve"]["weight_quant"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # page-native attention vs dense-gather: token identity and
+        # >= 1.2x at <= 25% occupancy ENFORCED (untracked)
+        if isinstance(extras.get("serve"), dict) \
+                and "error" not in extras["serve"]:
+            extras["serve"]["page_native"] = _bench_page_native()
+    except Exception as exc:
+        extras["serve"]["page_native"] = {
             "error": f"{type(exc).__name__}: {exc}"}
 
     try:

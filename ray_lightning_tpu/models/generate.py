@@ -72,6 +72,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ray_lightning_tpu.models.quant import dequantize_params
 from ray_lightning_tpu.models.transformer import latch_eos
 
 
@@ -192,12 +193,71 @@ def decode_step(model, params, cache, tokens: jax.Array,
     Returns ``(last_logits (B, V), cache)``. Sampling stays outside (the
     scan and the engine consume the logits differently — shared rng for a
     homogeneous batch vs per-request keys and sampling params).
+
+    ``params`` may be weight-quantized (:mod:`..models.quant`): the
+    entry guard dequantizes — a trace-time no-op on plain trees. The
+    serve programs dequantize once at THEIR entry (outside the step
+    scans), so this guard only fires for direct callers.
     """
+    params = dequantize_params(params)
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, tokens,
         positions=kv_positions, kv_positions=kv_positions,
         deterministic=True, mutable=["cache"])
     return _logits_only(outputs)[:, -1], updated["cache"]
+
+
+def _arena_apply(model, params, arena, tokens, kv_positions, page_table):
+    """Shared page-native ``model.apply`` plumbing: the arena's cache
+    tree rides as the ``cache`` collection (int8 arenas split their
+    ``(codes, scales)`` tuple across ``cache`` + ``kvscale``), and the
+    updated arena comes back in the same storage layout."""
+    quantized = isinstance(arena, tuple)
+    variables = {"params": params}
+    if quantized:
+        variables["cache"], variables["kvscale"] = arena
+        mutable = ["cache", "kvscale"]
+    else:
+        variables["cache"] = arena
+        mutable = ["cache"]
+    outputs, updated = model.apply(
+        variables, tokens, positions=kv_positions,
+        kv_positions=kv_positions, page_table=page_table,
+        deterministic=True, mutable=mutable)
+    new_arena = ((updated["cache"], updated["kvscale"]) if quantized
+                 else updated["cache"])
+    return _logits_only(outputs), new_arena
+
+
+def decode_step_paged(model, params, arena, tokens: jax.Array,
+                      kv_positions: jax.Array, page_table: jax.Array):
+    """Page-native sibling of :func:`decode_step`: ONE cached
+    single-token step whose K/V reads and writes go straight through
+    the serving engine's page arena — no dense per-slot view is
+    gathered or scattered (see
+    ``MultiHeadAttention._page_native_attention``).
+
+    ``arena`` is the paged KV tree (``(num_pages, page_size, H, D)``
+    leaves; int8 arenas are the usual ``(codes, scales)`` tuple) and
+    ``page_table`` (B, pages_per_slot) maps each row to its pages — the
+    engine passes its write-masked table, so retired/chunking rows'
+    parked writes drop. Returns ``(last_logits (B, V), arena)``.
+    """
+    params = dequantize_params(params)
+    logits, arena = _arena_apply(model, params, arena, tokens,
+                                 kv_positions, page_table)
+    return logits[:, -1], arena
+
+
+def verify_step_paged(model, params, arena, tokens: jax.Array,
+                      kv_positions: jax.Array, page_table: jax.Array):
+    """Page-native sibling of :func:`verify_step`: the speculative
+    verify's per-row (B, T) block scoring, reading/writing K/V through
+    the page table. Returns ``(logits (B, T, V), arena)`` — every
+    offset's logits, as the accept rule requires."""
+    params = dequantize_params(params)
+    return _arena_apply(model, params, arena, tokens, kv_positions,
+                        page_table)
 
 
 def verify_step(model, params, cache, tokens: jax.Array,
@@ -222,6 +282,7 @@ def verify_step(model, params, cache, tokens: jax.Array,
     land at or before those positions before any mask re-admits them
     (same argument as the chunk-prefill path).
     """
+    params = dequantize_params(params)
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, tokens,
         positions=kv_positions, kv_positions=kv_positions,
@@ -230,6 +291,7 @@ def verify_step(model, params, cache, tokens: jax.Array,
 
 
 def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
+    params = dequantize_params(params)
     B, P = prompt_tokens.shape
     prompt_tokens = prompt_tokens.astype(jnp.int32)
     cache = model.init(jax.random.PRNGKey(0),

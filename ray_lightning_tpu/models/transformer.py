@@ -26,6 +26,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ray_lightning_tpu.models.quant import (kv_dequantize, kv_quantize,
+                                            kv_scales)
 from ray_lightning_tpu.ops.attention import dot_product_attention
 
 
@@ -160,7 +162,8 @@ class MultiHeadAttention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None,
+                 page_table=None):
         cfg = self.cfg
         B, T, _ = x.shape
         qkv = nn.DenseGeneral(
@@ -171,6 +174,18 @@ class MultiHeadAttention(nn.Module):
         # TPU (376us/step at GPT-2-small bs8 in the v5e trace); slices
         # fuse into the attention consumers instead
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.decode and page_table is not None:
+            # page-native cached attention: K/V live in the serving
+            # engine's page arena and are read/written THROUGH the page
+            # table — no dense (B, max_seq_len) view ever materializes
+            out = self._page_native_attention(q, k, v, kv_positions,
+                                              page_table)
+            from jax.ad_checkpoint import checkpoint_name
+            out = checkpoint_name(out, "attn_out")
+            out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
+            return nn.DenseGeneral(
+                features=cfg.d_model, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="out")(out)
         causal = cfg.causal
         if cfg.decode:
             k, v, cache_mask = self._decode_cache(k, v, kv_positions)
@@ -270,6 +285,159 @@ class MultiHeadAttention(nn.Module):
                              big_neg)                           # (1,1,T,S)
         return ck.value, cv.value, mask
 
+    def _page_native_attention(self, q, k, v, kv_positions, page_table):
+        """Cached attention straight through the serving engine's page
+        arena — the gather-fusion half of the pallas endgame, in pure
+        XLA (see ``docs/serving.md``).
+
+        The ``cache`` collection holds the arena leaves themselves
+        (``(num_pages, page_size, H, D)``; int8 arenas put the codes
+        here and their absmax scales in a parallel ``kvscale``
+        collection), and ``page_table`` (B, pages_per_slot) maps each
+        row's logical pages to arena pages (−1 = unmapped). Instead of
+        materializing the dense ``(B, max_seq_len)`` per-slot view every
+        dispatch (the ``gather_pages``/``scatter_pages`` round trip,
+        whose bytes scale with ``num_slots x max_seq_len`` regardless of
+        occupancy), this path:
+
+        - **writes** the block's T tokens' K/V directly into the owning
+          pages at ``kv_positions`` (unmapped / write-masked rows drop;
+          int8 pages are read-modify-requantized one page at a time);
+        - **reads** K blockwise, one page column per iteration — scores
+          for all ``pages_per_slot`` columns are concatenated into the
+          SAME ``(B, H, T, max_seq_len)`` logits tensor the dense path
+          builds (tiny: no V-sized buffer), masked with the identical
+          per-row block-causal ``key <= kv_positions[row, q]`` rule, and
+          softmaxed in one exact f32 pass — no online-softmax
+          approximation, so outputs match the dense-gather path up to
+          reduction-order rounding in the final V accumulation;
+        - **accumulates** the output blockwise over V page columns in
+          f32.
+
+        Unmapped (−1) entries clamp to page 0 — finite stale bytes the
+        position mask never admits, the same argument as
+        ``gather_pages`` — and repeated clamped reads stay cache-hot:
+        the bytes actually streamed scale with *occupied* pages.
+        """
+        cfg = self.cfg
+        if kv_positions is None:
+            raise ValueError(
+                "page-native attention is a serving-engine mode and "
+                "needs per-row kv_positions (each row's absolute "
+                "sequence positions)")
+        B, T, H, D = k.shape
+
+        def _missing(what):
+            def init():
+                raise ValueError(
+                    f"page-native attention found no {what} — pass the "
+                    "paged KV arena as the 'cache' collection (int8 "
+                    "arenas add their scales as 'kvscale'); see "
+                    "decode_step_paged in models/generate.py")
+            return init
+
+        ck = self.variable("cache", "cached_key", _missing("cached_key"))
+        cv = self.variable("cache", "cached_value",
+                           _missing("cached_value"))
+        quantized = ck.value.dtype == jnp.int8
+        if quantized:
+            sk = self.variable("kvscale", "cached_key",
+                               _missing("cached_key scales"))
+            sv = self.variable("kvscale", "cached_value",
+                               _missing("cached_value scales"))
+        P, ps = ck.value.shape[0], ck.value.shape[1]
+        pp = page_table.shape[1]
+        pos = kv_positions.astype(jnp.int32)                    # (B, T)
+
+        def read_pages(store, scales, pidx):
+            block = jnp.take(store, pidx, axis=0)       # (B, ps, H, D)
+            if scales is None:
+                return block
+            return kv_dequantize(block, jnp.take(scales, pidx, axis=0),
+                                 k.dtype)
+
+        # ---- write first: the block attends its own just-written K/V
+        # (key <= pos admits each query's own position), exactly like
+        # the per-row mode of _decode_cache
+        rows = jnp.arange(B)
+        for t in range(T):
+            col = pos[:, t] // ps
+            off = pos[:, t] % ps
+            pidx = jnp.take_along_axis(page_table, col[:, None],
+                                       axis=1)[:, 0]            # (B,)
+            widx = jnp.where(pidx >= 0, pidx, P)   # −1 = dropped write
+            if not quantized:
+                ck.value = ck.value.at[widx, off].set(k[:, t],
+                                                      mode="drop")
+                cv.value = cv.value.at[widx, off].set(v[:, t],
+                                                      mode="drop")
+                continue
+            # int8: read-modify-requantize the one page this token
+            # lands in. NOTE this rounds MORE often than the
+            # dense-gather path (scatter_pages dequantizes once per
+            # dispatch, accumulates every sub-step's writes in full
+            # precision, requantizes once at the end; here each token
+            # round-trips its page immediately, so multi-step dispatches
+            # re-round a page's other entries whenever its absmax
+            # carrier moves) — int8 page-native vs dense-gather token
+            # identity is therefore EMPIRICAL (bounded extra rounding
+            # vs argmax margins, pinned on the test/bench configs incl.
+            # steps_per_dispatch>1), not structural like the
+            # full-precision case
+            g = jnp.clip(pidx, 0, P - 1)
+            for store, scales, new in ((ck, sk, k), (cv, sv, v)):
+                page = kv_dequantize(
+                    jnp.take(store.value, g, axis=0),
+                    jnp.take(scales.value, g, axis=0), new.dtype)
+                page = page.at[rows, off].set(new[:, t])
+                ns = kv_scales(page, (1, 3))
+                store.value = store.value.at[widx].set(
+                    kv_quantize(page, ns), mode="drop")
+                scales.value = scales.value.at[widx].set(ns,
+                                                         mode="drop")
+
+        # ---- scores blockwise over page columns, ONE exact softmax
+        scale = cfg.head_dim ** -0.5
+
+        def score_block(_, j):
+            pidx = jnp.clip(page_table[:, j], 0, P - 1)
+            kj = read_pages(ck.value, sk.value if quantized else None,
+                            pidx)
+            sj = jnp.einsum("bqhd,bkhd->bhqk", q, kj,
+                            preferred_element_type=jnp.float32)
+            return None, sj
+
+        _, scores = jax.lax.scan(score_block, None, jnp.arange(pp))
+        # (pp, B, H, T, ps) -> (B, H, T, pp*ps): page-major key order
+        # IS absolute position order (column j covers j*ps .. j*ps+ps-1)
+        logits = jnp.moveaxis(scores, 0, 3).reshape(
+            B, cfg.n_heads, T, pp * ps) * scale
+        key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, pp * ps),
+                                           3)
+        big_neg = jnp.finfo(jnp.float32).min
+        logits = logits + jnp.where(key_pos <= pos[:, None, :, None],
+                                    0.0, big_neg)
+        weights = jax.nn.softmax(logits, axis=-1)
+        all_masked = jnp.all(logits <= big_neg * 0.5, axis=-1,
+                             keepdims=True)
+        weights = jnp.where(all_masked, 0.0, weights).astype(q.dtype)
+
+        # ---- output accumulated blockwise over V page columns (f32)
+        def out_block(acc, j):
+            pidx = jnp.clip(page_table[:, j], 0, P - 1)
+            vj = read_pages(cv.value, sv.value if quantized else None,
+                            pidx)
+            wj = jax.lax.dynamic_slice_in_dim(weights, j * ps, ps,
+                                              axis=3)
+            return acc + jnp.einsum(
+                "bhqk,bkhd->bqhd", wj, vj,
+                preferred_element_type=jnp.float32), None
+
+        out, _ = jax.lax.scan(out_block,
+                              jnp.zeros((B, T, H, D), jnp.float32),
+                              jnp.arange(pp))
+        return out.astype(q.dtype)
+
 
 class MlpBlock(nn.Module):
     cfg: TransformerConfig
@@ -294,12 +462,13 @@ class TransformerBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None,
+                 page_table=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
         x = x + MultiHeadAttention(cfg, name="attn")(
             h, mask=mask, deterministic=deterministic,
-            kv_positions=kv_positions)
+            kv_positions=kv_positions, page_table=page_table)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
         x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic)
         return x
@@ -316,11 +485,11 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, mask, kv_positions = carry
+        x, mask, kv_positions, page_table = carry
         x = TransformerBlock(self.cfg, name="block")(
             x, mask=mask, deterministic=self.deterministic,
-            kv_positions=kv_positions)
-        return (x, mask, kv_positions), None
+            kv_positions=kv_positions, page_table=page_table)
+        return (x, mask, kv_positions, page_table), None
 
 
 def latch_eos(next_tokens: jax.Array, done: jax.Array, eos_id):
@@ -405,7 +574,8 @@ class TransformerStack(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic=True, kv_positions=None):
+    def __call__(self, x, mask=None, deterministic=True, kv_positions=None,
+                 page_table=None):
         cfg = self.cfg
         if cfg.scan_layers:
             block_cls = _ScanBlock
@@ -415,19 +585,22 @@ class TransformerStack(nn.Module):
                     static_argnums=(), policy=_remat_policy(cfg))
             stack = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "cache": 0},
+                # kvscale: int8 page arenas carry per-layer absmax
+                # scales alongside the per-layer cache codes (absent —
+                # and free — everywhere else)
+                variable_axes={"params": 0, "cache": 0, "kvscale": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 unroll=min(cfg.scan_unroll, cfg.n_layers),
                 metadata_params={nn.PARTITION_NAME: "layers"})
-            (x, _, _), _ = stack(cfg, deterministic, name="layers")(
-                (x, mask, kv_positions), None)
+            (x, _, _, _), _ = stack(cfg, deterministic, name="layers")(
+                (x, mask, kv_positions, page_table), None)
             return x
         block_cls = maybe_remat(TransformerBlock, cfg,
                                 deterministic_argnum=3)
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block_{i}")(x, mask, deterministic,
-                                                  kv_positions)
+                                                  kv_positions, page_table)
         return x
 
 
@@ -509,6 +682,15 @@ class TransformerLM(nn.Module):
     speculative-decode verify path); leave None for the shared-index
     path (uniform decode steps and block prefill).
 
+    ``page_table`` (B, pages_per_slot) additionally switches the cached
+    attention to its **page-native** mode: K/V are read and written
+    directly through the serving engine's page arena (passed as the
+    ``cache`` collection; int8 arenas add a ``kvscale`` collection)
+    instead of a dense per-row cache — see
+    :meth:`MultiHeadAttention._page_native_attention` and
+    :func:`ray_lightning_tpu.models.generate.decode_step_paged`.
+    Requires ``kv_positions``.
+
     ``return_hidden=True`` returns the final hidden states (after
     ``ln_f``) instead of logits, for the chunked LM-head loss path
     (:func:`ray_lightning_tpu.ops.lm_head_loss.chunked_lm_head_xent`)
@@ -518,7 +700,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True, positions=None,
-                 return_hidden: bool = False, kv_positions=None):
+                 return_hidden: bool = False, kv_positions=None,
+                 page_table=None):
         cfg = self.cfg
         B, T = tokens.shape
         if positions is None:  # decode mode passes cache-index positions
@@ -532,7 +715,8 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wpe")(pos)
         x = TransformerStack(cfg, name="stack")(
-            x, deterministic=deterministic, kv_positions=kv_positions)
+            x, deterministic=deterministic, kv_positions=kv_positions,
+            page_table=page_table)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x

@@ -166,6 +166,17 @@ def actor_alive(worker: Any) -> bool:
     expose ``_proc``, fakes expose ``_killed``, real Ray handles (no
     cheap local probe) default to alive — death still surfaces through
     the failed future that triggered the probe."""
+    if getattr(worker, "_dead", False):
+        # the process backend's reader thread latches ``_dead`` the
+        # moment it observes the pipe EOF — BEFORE it fails the future
+        # whose failure triggers this probe. Authoritative, and immune
+        # to the race below: ``is_alive()`` polls waitpid, which can
+        # still report a just-exited child as running in the window
+        # between its connection teardown and process teardown, so a
+        # hard-killed worker could read "alive" and get classified
+        # worker.error instead of worker.dead (a load-dependent flake
+        # the full suite surfaced)
+        return False
     proc = getattr(worker, "_proc", None)
     if proc is not None:
         try:

@@ -52,8 +52,12 @@ class ServeClient:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = False,
                  kv_dtype: Optional[str] = None,
+                 page_native: bool = False,
+                 weight_dtype: Optional[str] = None,
+                 weight_group_size: Optional[int] = None,
                  draft_model=None, draft_params=None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 draft_weight_dtype: Optional[str] = None):
         engine_kwargs = dict(
             num_slots=num_slots, prefill_batch=prefill_batch,
             prefill_len=prefill_len,
@@ -61,8 +65,10 @@ class ServeClient:
             telemetry=telemetry, page_size=page_size,
             num_pages=num_pages, prefill_chunk=prefill_chunk,
             prefix_cache=prefix_cache, kv_dtype=kv_dtype,
+            page_native=page_native, weight_dtype=weight_dtype,
+            weight_group_size=weight_group_size,
             draft_model=draft_model, draft_params=draft_params,
-            spec_k=spec_k)
+            spec_k=spec_k, draft_weight_dtype=draft_weight_dtype)
         if retry_policy is not None:
             # supervised engine: dispatch crashes rebuild + replay under
             # the policy instead of unwinding through the client loop;

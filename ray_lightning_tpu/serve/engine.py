@@ -41,6 +41,16 @@ prefill/decode split from :mod:`ray_lightning_tpu.models.generate`:
 f32 scales (per-page-per-head paged, per-position-per-head dense) —
 dequantized on the way into every program and re-quantized on the way
 out, fused into the dispatch; compute stays at ``cfg.dtype``.
+``weight_dtype="int8"|"int4"`` applies the same storage-only contract
+to the *parameters* (:mod:`ray_lightning_tpu.models.quant`):
+per-output-channel int8 or group-wise packed int4 codes + f32 scales,
+dequantized ONCE at each program's entry — the at-rest param stream
+(what every decode pass reads) shrinks to the codes.
+``page_native=True`` (paged engines) swaps the step/verify programs'
+dense-view gather/scatter for attention that reads and writes K/V
+straight through the page table inside the model — dispatch bytes
+scale with *occupied* pages, token-identical to the dense-gather path
+(see ``docs/serving.md``).
 
 KV layout is split from the programs (the refactor ROADMAP item 1 calls
 healthy): the *logical* per-slot ``(max_seq_len, H, D)`` KV each program
@@ -76,7 +86,12 @@ import numpy as np
 
 from ray_lightning_tpu.models.generate import (_logits_only, _prefill_impl,
                                                decode_step,
+                                               decode_step_paged,
                                                sample_logits_rows)
+from ray_lightning_tpu.models.quant import (DEFAULT_GROUP_SIZE,
+                                            check_weight_dtype,
+                                            dequantize_params, param_bytes,
+                                            quantize_params)
 from ray_lightning_tpu.models.transformer import latch_eos
 from ray_lightning_tpu.obs.spans import NULL_SPAN
 from ray_lightning_tpu.reliability import faults
@@ -88,7 +103,10 @@ from ray_lightning_tpu.serve.pages import (PagePool, PrefixCache,
                                            gather_pages, pick_donated,
                                            quantize_dense_cache,
                                            scatter_pages)
-from ray_lightning_tpu.serve.spec import (SpecDecoder, _spec_paged_donated,
+from ray_lightning_tpu.serve.spec import (SpecDecoder,
+                                          _spec_page_native_donated,
+                                          _spec_page_native_plain,
+                                          _spec_paged_donated,
                                           _spec_paged_plain,
                                           _spec_rounds_donated,
                                           _spec_rounds_plain)
@@ -104,20 +122,18 @@ _fold_rows = fold_rows
 _pick = pick_donated
 
 
-def _engine_step_core(model, params, cache, cur, pos, active, remaining,
-                      temp, top_k, eos, keys, stepno):
-    """One decode step for all B slots. Pure function of the engine state
-    arrays; (B, 1) model step shared with generate() via decode_step.
+def _advance_rows(model, last, cur, pos, active, remaining, temp, top_k,
+                  eos, keys, stepno):
+    """Per-row sampling + bookkeeping for one decode step's logits — the
+    ONE copy of the sample/latch/budget math, shared by the dense-view
+    step core and the page-native step body so the two storage paths
+    cannot drift.
 
     Per-row semantics (matching the ragged decode scan): ``cur`` is the
-    token sampled last step, ``pos`` its absolute position — the step
-    writes its K/V there, masks keys beyond it, samples the next token at
-    ``pos + 1``. Inactive rows run the same math (static shapes) but their
-    state is frozen: emitted is masked to −1, ``pos``/``stepno`` don't
-    advance, and re-writing the same K/V at the same position is
-    idempotent.
+    token sampled last step, ``pos`` its absolute position. Inactive rows
+    run the same math (static shapes) but their state is frozen: emitted
+    is masked to −1 and ``pos``/``stepno`` don't advance.
     """
-    last, cache = decode_step(model, params, cache, cur, pos)
     step_keys = _fold_rows(keys, stepno)
     nxt = sample_logits_rows(last, step_keys, temp, top_k)
     # per-row eos (−1 = disabled); done=False — finished rows leave the
@@ -132,6 +148,21 @@ def _engine_step_core(model, params, cache, cur, pos, active, remaining,
     pos = jnp.minimum(pos + act_i[:, None], max_pos)
     stepno = stepno + act_i
     active = active & ~finished
+    return (cur, pos, active, remaining, stepno, emitted, finished)
+
+
+def _engine_step_core(model, params, cache, cur, pos, active, remaining,
+                      temp, top_k, eos, keys, stepno):
+    """One decode step for all B slots. Pure function of the engine state
+    arrays; (B, 1) model step shared with generate() via decode_step,
+    row bookkeeping shared with the page-native path via
+    :func:`_advance_rows`. Re-writing a frozen row's K/V at its frozen
+    position is idempotent.
+    """
+    last, cache = decode_step(model, params, cache, cur, pos)
+    (cur, pos, active, remaining, stepno, emitted, finished) = \
+        _advance_rows(model, last, cur, pos, active, remaining, temp,
+                      top_k, eos, keys, stepno)
     return (cache, cur, pos, active, remaining, stepno, emitted, finished)
 
 
@@ -155,6 +186,10 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
     Returns the carried state plus ``emitted``/``finished`` stacked
     ``(steps, B)`` — the host replays sub-steps in order.
     """
+    # weight-quantized params dequantize ONCE per dispatch, here at the
+    # program top (outside the step scan) — storage-only, same contract
+    # as the int8 KV storage below
+    params = dequantize_params(params)
     storage = cache
     cache = dense_storage_values(model, storage)
 
@@ -302,6 +337,7 @@ def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
     (one program covers every chunk). ``startno`` continues a replayed
     request's key stream, exactly as the batched prefill does.
     """
+    params = dequantize_params(params)
     pt = row_pages[None, :]
     view = _gather_pages(model, arena, pt)
     view = jax.tree_util.tree_map(
@@ -320,6 +356,44 @@ def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
                                top_k)
     arena = _scatter_pages(model, arena, updated["cache"], pt)
     return arena, first
+
+
+def _page_native_step_impl(model, params, arena, page_table, cur, pos,
+                           active, remaining, temp, top_k, eos, keys,
+                           stepno, *, steps):
+    """The decode step program in **page-native** mode: K/V reads and
+    writes go straight through the page table inside the model's
+    attention (``decode_step_paged`` →
+    ``MultiHeadAttention._page_native_attention``) — the dense
+    ``(num_slots, max_seq_len)`` view of :func:`_paged_step_impl` never
+    materializes, so the bytes a dispatch touches scale with *occupied*
+    pages instead of ``num_slots x max_seq_len``. Row bookkeeping is
+    the shared :func:`_advance_rows`, so sampling/eos/budget math is
+    identical to the dense-view paths by construction.
+
+    ``page_table`` arrives write-masked (inactive rows' entries −1):
+    their parked writes drop inside the attention scatter and their
+    reads clamp to page 0 (finite junk the position mask never lets
+    into an ACTIVE row — inactive rows' logits are discarded by the
+    emitted mask). Rows that retire mid-block keep their mapped entries
+    and re-write frozen K/V idempotently, exactly like the dense paths.
+    """
+    params = dequantize_params(params)
+
+    def body(carry, _):
+        arena, cur, pos, active, remaining, stepno = carry
+        last, arena = decode_step_paged(model, params, arena, cur, pos,
+                                        page_table)
+        (cur, pos, active, remaining, stepno, emitted, finished) = \
+            _advance_rows(model, last, cur, pos, active, remaining,
+                          temp, top_k, eos, keys, stepno)
+        return ((arena, cur, pos, active, remaining, stepno),
+                (emitted, finished))
+
+    (arena, cur, pos, active, remaining, stepno), (emitted, finished) = \
+        jax.lax.scan(body, (arena, cur, pos, active, remaining, stepno),
+                     None, length=steps)
+    return (arena, cur, pos, active, remaining, stepno, emitted, finished)
 
 
 _engine_step_donated = partial(
@@ -347,6 +421,11 @@ _chunk_prefill_donated = partial(
         _chunk_prefill_impl)
 _chunk_prefill_plain = partial(
     jax.jit, static_argnames=("model",))(_chunk_prefill_impl)
+_page_native_step_donated = partial(
+    jax.jit, static_argnames=("model", "steps"), donate_argnums=(2,))(
+        _page_native_step_impl)
+_page_native_step_plain = partial(
+    jax.jit, static_argnames=("model", "steps"))(_page_native_step_impl)
 
 
 
@@ -447,7 +526,11 @@ class ServeEngine:
     ``spec_k=4``): ``step()`` runs fused spec rounds instead of decode
     steps — see :mod:`ray_lightning_tpu.serve.spec` and
     ``docs/serving.md``. ``kv_dtype="int8"`` halves at-rest KV bytes
-    on either storage layout (``docs/serving.md#int8-kv-storage``).
+    on either storage layout (``docs/serving.md#int8-kv-storage``);
+    ``weight_dtype=`` / ``draft_weight_dtype=`` quantize the weights
+    (``weight_group_size=`` sizes the int4 groups) and
+    ``page_native=True`` drops the paged dispatch's dense-view
+    round-trip — all four compose, with each other and with spec.
 
     Drive it with :class:`~ray_lightning_tpu.serve.client.ServeClient`
     (scheduler + admission control + clocks) or directly:
@@ -465,8 +548,12 @@ class ServeEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = False,
                  kv_dtype: Optional[str] = None,
+                 page_native: bool = False,
+                 weight_dtype: Optional[str] = None,
+                 weight_group_size: Optional[int] = None,
                  draft_model=None, draft_params=None,
-                 spec_k: Optional[int] = None):
+                 spec_k: Optional[int] = None,
+                 draft_weight_dtype: Optional[str] = None):
         cfg = model.cfg
         if not cfg.decode:
             raise ValueError(
@@ -474,6 +561,18 @@ class ServeEngine:
                 "config with decode=True (params are compatible)")
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if page_native and page_size is None:
+            raise ValueError(
+                "page_native=True is a paged-KV mode (attention reads "
+                "K/V through the page table): pass page_size= too")
+        check_weight_dtype(weight_dtype)  # unknown dtypes refused here
+        check_weight_dtype(draft_weight_dtype)
+        if weight_group_size is not None \
+                and "int4" not in (weight_dtype, draft_weight_dtype):
+            raise ValueError(
+                "weight_group_size is an int4 grouping option: pass "
+                "weight_dtype='int4' (or draft_weight_dtype='int4') to "
+                "enable it — int8 scales are per-output-channel")
         if prefill_len > cfg.max_seq_len:
             raise ValueError(
                 f"prefill_len ({prefill_len}) exceeds max_seq_len "
@@ -514,7 +613,29 @@ class ServeEngine:
                 "target's vocab and max_seq_len) to enable them")
         if draft_model is not None and draft_params is None:
             raise ValueError("draft_model needs draft_params too")
+        if draft_weight_dtype is not None and draft_model is None:
+            raise ValueError(
+                "draft_weight_dtype is a speculative-decoding option: "
+                "pass draft_model=/draft_params= to enable it")
         self.model = model
+        # weight-only quantization (models/quant.py): storage-only —
+        # the programs dequantize once per dispatch, compute stays at
+        # cfg.dtype. Quantizing here (not at the call site) keeps
+        # supervisor rebuilds deterministic: the raw params re-quantize
+        # to bit-identical codes, so crash replay stays token-identical.
+        self.weight_dtype = weight_dtype
+        self._weights_quantized_events = []
+        # weight_group_size feeds whichever models quantize as int4
+        # (int8 is per-output-channel — quantize_params refuses a group)
+        if weight_dtype is not None:
+            params = self._quantize_weights(
+                "target", params, weight_dtype,
+                weight_group_size if weight_dtype == "int4" else None)
+        if draft_weight_dtype is not None:
+            draft_params = self._quantize_weights(
+                "draft", draft_params, draft_weight_dtype,
+                weight_group_size if draft_weight_dtype == "int4"
+                else None)
         self.params = params
         self.num_slots = num_slots
         if prefill_batch is not None and prefill_batch < 1:
@@ -547,6 +668,7 @@ class ServeEngine:
         self.kv_dtype = kv_dtype
         check_kv_dtype(kv_dtype)
         self.paged = page_size is not None
+        self.page_native = page_native
         if self.paged:
             self.pool = PagePool(model, num_slots, page_size,
                                  num_pages=num_pages, kv_dtype=kv_dtype)
@@ -600,6 +722,35 @@ class ServeEngine:
         self.spec_accepted_tokens = 0
         self.spec_rejected_tokens = 0
         self.spec_draft_steps = 0
+
+        if telemetry is not None:
+            for payload in self._weights_quantized_events:
+                telemetry.event("engine.weights_quantized", **payload)
+            telemetry.metrics.gauge(
+                "serve_param_bytes",
+                help="at-rest parameter bytes this engine streams per "
+                "decode pass (target + draft; quantized codes + scales "
+                "when weight_dtype is set)"
+            ).set(param_bytes(self.params)
+                  + (param_bytes(self.spec.params)
+                     if self.spec is not None else 0))
+        self._weights_quantized_events = []
+
+    def _quantize_weights(self, which: str, params, weight_dtype: str,
+                          group_size: Optional[int]):
+        """Quantize one model's params, recording the before/after byte
+        accounting for the armed-telemetry event (emitted at the end of
+        ``__init__`` — quantization must run before the telemetry handle
+        is even assigned)."""
+        before = param_bytes(params)
+        quantized = quantize_params(params, weight_dtype,
+                                    group_size=group_size)
+        self._weights_quantized_events.append(dict(
+            model=which, dtype=weight_dtype,
+            group_size=(None if weight_dtype == "int8"
+                        else group_size or DEFAULT_GROUP_SIZE),
+            bytes_before=before, bytes_after=param_bytes(quantized)))
+        return quantized
 
     # ------------------------------------------------------------- state
     @property
@@ -1002,7 +1153,24 @@ class ServeEngine:
         tel = self._tel
         with (tel.span("engine.step", active=int(self._active.sum()))
               if tel is not None else NULL_SPAN):
-            if self.paged:
+            if self.paged and self.page_native:
+                # page-native: attention reads/writes K/V through the
+                # (write-masked) page table inside the model — no dense
+                # view gather/scatter per dispatch. Token-identical to
+                # the dense-gather path up to reduction-order rounding
+                # (int8 arenas: plus per-token page requant rounding —
+                # docs/serving.md caveat); pinned by tests/test_paged.py
+                # and the bench's enforced 0-mismatch gate.
+                fn = _pick(_page_native_step_donated,
+                           _page_native_step_plain)
+                (self.pool.arena, cur, pos, active, remaining, stepno,
+                 emitted, finished) = fn(
+                    self.model, self.params, self.pool.arena,
+                    self._write_masked_table(), self._cur, self._pos,
+                    self._active, self._remaining, self._temp,
+                    self._top_k, self._eos, self._keys, self._stepno,
+                    steps=self.steps_per_dispatch)
+            elif self.paged:
                 fn = _pick(_paged_step_donated, _paged_step_plain)
                 # the table copy re-uploads H2D every dispatch though it
                 # only changes at admit/retire — known headroom for the
@@ -1052,6 +1220,14 @@ class ServeEngine:
                       active=self.active_count, retired=len(done))
         return done
 
+    def _write_masked_table(self) -> np.ndarray:
+        """The page table with inactive rows' entries masked to −1 —
+        what every page-native program receives: a mid-chunking slot's
+        pages (allocated, not yet decoding) must never see a parked
+        decode write, and retired rows' reads may clamp harmlessly."""
+        return np.where(self._active[:, None], self.pool.page_table,
+                        -1).astype(np.int32)
+
     def _spec_step(self) -> List[Completion]:
         """One speculative dispatch: refill stale draft rows, then run
         ``steps_per_dispatch`` spec rounds (k+1 draft feeds + one
@@ -1076,7 +1252,20 @@ class ServeEngine:
         k, rounds = spec.k, self.steps_per_dispatch
         with (tel.span("engine.spec_round", active=int(self._active.sum()),
                        k=k) if tel is not None else NULL_SPAN):
-            if self.paged:
+            if self.paged and self.page_native:
+                # the widened verify reads/writes target K/V through
+                # the page table too — spec and page-native compose on
+                # one engine (the draft cache stays dense either way)
+                fn = _pick(_spec_page_native_donated,
+                           _spec_page_native_plain)
+                (self.pool.arena, spec.cache, cur, pos, active, remaining,
+                 stepno, emitted, accepted, rejected, finished) = fn(
+                    self.model, spec.model, self.params, spec.params,
+                    self.pool.arena, self._write_masked_table(),
+                    spec.cache, self._cur, self._pos, self._active,
+                    self._remaining, self._temp, self._top_k, self._eos,
+                    self._keys, self._stepno, k=k, rounds=rounds)
+            elif self.paged:
                 fn = _pick(_spec_paged_donated, _spec_paged_plain)
                 (self.pool.arena, spec.cache, cur, pos, active, remaining,
                  stepno, emitted, accepted, rejected, finished) = fn(

@@ -385,10 +385,15 @@ class ReplicaFleet:
     num_slots=4, ...)`` — engine keyword arguments are forwarded to
     every replica (and to warm standbys), so the whole fleet compiles
     the same fixed-shape programs and any replica can seat any
-    request. ``submit()`` routes one request; ``serve_trace()`` /
-    ``run_until_idle()`` mirror the single-client surface. Call
-    :meth:`shutdown` when done — it releases every replica's KV
-    pool/arena, the standby pool, and the router.
+    request; that includes the decode-bandwidth levers
+    (``kv_dtype="int8"``, ``weight_dtype="int8"|"int4"``,
+    ``page_native=True``, ``draft_model=``/``spec_k=``) — every
+    replica re-quantizes the shared raw params to bit-identical codes,
+    so failover replay onto a sibling stays token-identical (pinned by
+    ``tests/test_quant.py``). ``submit()`` routes one request;
+    ``serve_trace()`` / ``run_until_idle()`` mirror the single-client
+    surface. Call :meth:`shutdown` when done — it releases every
+    replica's KV pool/arena, the standby pool, and the router.
 
     Failure semantics: a replica that crashes (its dispatch raises —
     including ``serve.replica`` ``raise`` faults) or hangs (stops

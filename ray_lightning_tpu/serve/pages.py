@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_lightning_tpu.models.quant import (kv_dequantize, kv_quantize,
+                                            kv_scales)
 from ray_lightning_tpu.serve.request import OccupancyError
 
 #: accepted ``kv_dtype`` spellings: None/"bf16" = store KV at the model's
@@ -68,22 +70,11 @@ def check_kv_dtype(kv_dtype) -> bool:
 # ``s_tree``. The tuple flows through the jitted programs as an
 # ordinary pytree — dequantize on the way in, re-quantize on the way
 # out, both fused into the dispatch.
-
-def kv_scales(values: jax.Array, reduce_axes: Tuple[int, ...]) -> jax.Array:
-    """Absmax scales over ``reduce_axes`` (keepdims), guarded so an
-    all-zero group dequantizes to exact zeros instead of NaN."""
-    amax = jnp.max(jnp.abs(values.astype(jnp.float32)), axis=reduce_axes,
-                   keepdims=True)
-    return jnp.where(amax > 0, amax / 127.0, 1.0)
-
-
-def kv_quantize(values: jax.Array, scales: jax.Array) -> jax.Array:
-    return jnp.clip(jnp.round(values.astype(jnp.float32) / scales),
-                    -127, 127).astype(jnp.int8)
-
-
-def kv_dequantize(q: jax.Array, scales: jax.Array, dtype) -> jax.Array:
-    return (q.astype(jnp.float32) * scales).astype(dtype)
+#
+# The absmax quantize/dequantize math itself lives in models/quant.py
+# (imported above): the page-native attention path inside the model
+# needs the identical functions, and models must not depend on serve —
+# re-exported here so existing callers keep their import site.
 
 
 def _dense_reduce_axes(leaf) -> Tuple[int, ...]:
@@ -398,7 +389,12 @@ class PagePool:
 
                 def s_leaf(leaf):
                     if leaf.ndim < 4:
-                        return jnp.zeros((), jnp.float32)
+                        # placeholder mirrors the bookkeeping leaf's
+                        # SHAPE (not a scalar): the page-native path
+                        # ships the scales tree as a flax collection,
+                        # and scanned layouts slice every leaf of it
+                        # along the layer axis
+                        return jnp.zeros(leaf.shape, jnp.float32)
                     shape = list(leaf.shape)
                     for ax in _page_reduce_axes(axis, leaf):
                         shape[ax] = 1

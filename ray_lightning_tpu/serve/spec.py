@@ -69,7 +69,9 @@ import numpy as np
 
 from ray_lightning_tpu.models.generate import (_prefill_impl, decode_step,
                                                sample_logits_rows,
-                                               verify_step)
+                                               verify_step,
+                                               verify_step_paged)
+from ray_lightning_tpu.models.quant import dequantize_params
 from ray_lightning_tpu.serve.pages import (dense_storage_commit,
                                            dense_storage_values,
                                            fold_rows, gather_pages,
@@ -212,6 +214,33 @@ def _spec_accept(L, draft_toks, draft_logits, cur, pos, active, remaining,
             rejected, finished)
 
 
+def _draft_propose(draft_model, draft_params, draft_cache, cur, pos,
+                   keys, stepno, temp, top_k, max_pos, *, k):
+    """One round's draft half, shared by every spec program variant:
+    k+1 single-token draft feeds — iteration j feeds token t_j (t_0 =
+    cur, then the proposals) at ``pos + j`` and proposes d_{j+1}; the
+    last proposal is discarded, its feed is the full-accept KV
+    coverage. Returns ``(draft_cache, draft_toks (B, k),
+    draft_logits (B, k, V))``."""
+
+    def draft_step(dc, j):
+        draft_cache, t = dc
+        logits, draft_cache = decode_step(
+            draft_model, draft_params, draft_cache, t,
+            jnp.minimum(pos + j, max_pos))
+        sk = _fold_rows(keys, stepno + j)
+        dk = _fold_rows(
+            sk, jnp.full(stepno.shape, _DRAFT_STREAM, jnp.int32))
+        d = sample_logits_rows(logits, dk, temp, top_k)
+        return (draft_cache, d[:, None]), (d, logits)
+
+    (draft_cache, _), (drafts, dlogits) = jax.lax.scan(
+        draft_step, (draft_cache, cur), jnp.arange(k + 1))
+    draft_toks = jnp.moveaxis(drafts, 0, 1)[:, :k]       # (B, k)
+    draft_logits = jnp.moveaxis(dlogits, 0, 1)[:, :k]    # (B, k, V)
+    return draft_cache, draft_toks, draft_logits
+
+
 def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
                       draft_cache, cur, pos, active, remaining, temp,
                       top_k, eos, keys, stepno, *, k, rounds):
@@ -226,34 +255,21 @@ def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
     shapes); their junk draft/verify writes land in storage the next
     admission fully overwrites (dense whole-row inject / paged page
     re-inject — the paged wrapper additionally write-masks them).
-    ``cache`` may be int8 dense storage, handled like the plain step.
+    ``cache`` may be int8 dense storage, handled like the plain step;
+    ``params``/``draft_params`` may be weight-quantized — dequantized
+    here once per dispatch, outside the round scan.
     """
+    params = dequantize_params(params)
+    draft_params = dequantize_params(draft_params)
     storage = cache
     cache = dense_storage_values(model, storage)
     max_pos = model.cfg.max_seq_len - 1
 
     def round_body(carry, _):
         cache, draft_cache, cur, pos, active, remaining, stepno = carry
-
-        def draft_step(dc, j):
-            draft_cache, t = dc
-            logits, draft_cache = decode_step(
-                draft_model, draft_params, draft_cache, t,
-                jnp.minimum(pos + j, max_pos))
-            sk = _fold_rows(keys, stepno + j)
-            dk = _fold_rows(
-                sk, jnp.full(stepno.shape, _DRAFT_STREAM, jnp.int32))
-            d = sample_logits_rows(logits, dk, temp, top_k)
-            return (draft_cache, d[:, None]), (d, logits)
-
-        # k+1 feeds: iteration j feeds token t_j (t_0 = cur, then the
-        # proposals) at pos+j and proposes d_{j+1}; the last proposal is
-        # discarded, its feed is the full-accept KV coverage
-        (draft_cache, _), (drafts, dlogits) = jax.lax.scan(
-            draft_step, (draft_cache, cur), jnp.arange(k + 1))
-        draft_toks = jnp.moveaxis(drafts, 0, 1)[:, :k]       # (B, k)
-        draft_logits = jnp.moveaxis(dlogits, 0, 1)[:, :k]    # (B, k, V)
-
+        draft_cache, draft_toks, draft_logits = _draft_propose(
+            draft_model, draft_params, draft_cache, cur, pos, keys,
+            stepno, temp, top_k, max_pos, k=k)
         tokens_in = jnp.concatenate([cur, draft_toks], axis=1)
         vpos = jnp.minimum(pos + jnp.arange(k + 1)[None, :], max_pos)
         L, cache = verify_step(model, params, cache, tokens_in, vpos)
@@ -294,6 +310,51 @@ def _spec_rounds_paged_impl(model, draft_model, params, draft_params,
             emitted, accepted, rejected, finished)
 
 
+def _spec_rounds_page_native_impl(model, draft_model, params,
+                                  draft_params, arena, page_table,
+                                  draft_cache, cur, pos, active,
+                                  remaining, temp, top_k, eos, keys,
+                                  stepno, *, k, rounds):
+    """The spec round program in **page-native** mode: the widened
+    ``(B, k+1)`` verify reads and writes target K/V straight through
+    the (write-masked) page table inside the model's attention
+    (:func:`~ray_lightning_tpu.models.generate.verify_step_paged`) —
+    no dense view gathers or scatters per dispatch. The draft half and
+    the accept rule are byte-for-byte the shared
+    :func:`_draft_propose` / :func:`_spec_accept`, so commits cannot
+    drift from the dense-gather spec path. Rollback stays a position
+    decrement: rejected drafts' K/V landed in pages the slot already
+    owns, and writes past its span dropped at the page-table mask.
+    """
+    params = dequantize_params(params)
+    draft_params = dequantize_params(draft_params)
+    max_pos = model.cfg.max_seq_len - 1
+
+    def round_body(carry, _):
+        arena, draft_cache, cur, pos, active, remaining, stepno = carry
+        draft_cache, draft_toks, draft_logits = _draft_propose(
+            draft_model, draft_params, draft_cache, cur, pos, keys,
+            stepno, temp, top_k, max_pos, k=k)
+        tokens_in = jnp.concatenate([cur, draft_toks], axis=1)
+        vpos = jnp.minimum(pos + jnp.arange(k + 1)[None, :], max_pos)
+        L, arena = verify_step_paged(model, params, arena, tokens_in,
+                                     vpos, page_table)
+        (cur, pos, active, remaining, stepno, emitted, accepted,
+         rejected, finished) = _spec_accept(
+            L, draft_toks, draft_logits, cur, pos, active, remaining,
+            temp, top_k, eos, keys, stepno, max_pos, k=k)
+        return ((arena, draft_cache, cur, pos, active, remaining,
+                 stepno), (emitted, accepted, rejected, finished))
+
+    (arena, draft_cache, cur, pos, active, remaining, stepno), \
+        (emitted, accepted, rejected, finished) = jax.lax.scan(
+            round_body,
+            (arena, draft_cache, cur, pos, active, remaining, stepno),
+            None, length=rounds)
+    return (arena, draft_cache, cur, pos, active, remaining, stepno,
+            emitted, accepted, rejected, finished)
+
+
 def _draft_refill_impl(draft_model, draft_params, pool_cache, tokens,
                        length, slot):
     """Rebuild ONE slot's draft KV row from its full host-side context:
@@ -325,6 +386,11 @@ _spec_paged_donated = partial(
         _spec_rounds_paged_impl)
 _spec_paged_plain = partial(
     jax.jit, static_argnames=_STATICS)(_spec_rounds_paged_impl)
+_spec_page_native_donated = partial(
+    jax.jit, static_argnames=_STATICS, donate_argnums=(4, 6))(
+        _spec_rounds_page_native_impl)
+_spec_page_native_plain = partial(
+    jax.jit, static_argnames=_STATICS)(_spec_rounds_page_native_impl)
 _draft_refill_donated = partial(
     jax.jit, static_argnames=("draft_model",), donate_argnums=(2,))(
         _draft_refill_impl)

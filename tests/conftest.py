@@ -83,6 +83,11 @@ def pytest_configure(config):
         "markers", "spec: speculative decoding + int8 KV quantization "
         "(draft/verify programs, acceptance rules, quantized storage) — "
         "`pytest -m spec` runs it as a fast targeted subset")
+    config.addinivalue_line(
+        "markers", "quant: weight-only int8/int4 quantization + "
+        "page-native attention (QTensor storage, pack/unpack, "
+        "param-byte accounting, page-table-direct KV) — "
+        "`pytest -m quant` runs it as a fast targeted subset")
 
 
 @pytest.fixture(autouse=True)

@@ -667,3 +667,194 @@ def test_replay_rebuilds_prefix_sharing_on_undercommitted_arena(nano):
     for rid in (0, 1):
         assert out[rid].tokens == base[rid].tokens == ref[rid], rid
         assert out[rid].finish_reason == FINISH_LENGTH
+
+
+# --------------------------------------------------------------------- #
+# page-native attention: no dense view, token-identical (quant marker)
+# --------------------------------------------------------------------- #
+@pytest.mark.quant
+@pytest.mark.parametrize("page_size,steps", [(4, 1), (8, 1), (4, 3)])
+def test_page_native_matches_dense_gather(nano, page_size, steps):
+    """The acceptance pin: page-native attention (K/V read/written
+    straight through the page table inside the model — no per-dispatch
+    dense-view gather/scatter) emits exactly the dense-gather engine's
+    greedy tokens across page sizes and multi-step dispatch, on the
+    staggered mid-flight trace."""
+    dec, params = nano
+    kw = dict(num_slots=3, prefill_len=8, page_size=page_size,
+              steps_per_dispatch=steps)
+    base = ServeClient(dec, params, **kw)
+    ref = base.serve_trace(TRACE)
+    base.shutdown()
+    native = ServeClient(dec, params, page_native=True, **kw)
+    out = native.serve_trace(TRACE)
+    native.shutdown()
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, (page_size, steps,
+                                                    rid)
+        assert out[rid].finish_reason == ref[rid].finish_reason
+    windows = _ref_windows(dec, params, PROMPTS, 6)
+    for rid in range(4):
+        assert out[rid].tokens == windows[rid]
+
+
+@pytest.mark.quant
+def test_page_native_eos_and_sampled(nano):
+    """Eos retires page-native rows mid-flight exactly like the dense
+    paths, and sampled streams (per-request keys) match the
+    dense-gather engine draw-for-draw — the fold_in key plumbing is
+    shared, only the KV storage access changed."""
+    dec, params = nano
+    free = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                       page_size=4)
+    out0 = free.serve_trace(TRACE)
+    free.shutdown()
+    eos = out0[0].tokens[2]
+    trace = [(t, dict(**kw, eos_id=eos)) for t, kw in TRACE]
+    strace = [(t, dict(kw, temperature=0.8, top_k=8, seed=50 + i))
+              for i, (t, kw) in enumerate(TRACE)]
+    for tr in (trace, strace):
+        a = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                        page_size=4)
+        ref = a.serve_trace(list(tr))
+        a.shutdown()
+        b = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                        page_size=4, page_native=True)
+        out = b.serve_trace(list(tr))
+        b.shutdown()
+        for rid in ref:
+            assert out[rid].tokens == ref[rid].tokens, rid
+            assert out[rid].finish_reason == ref[rid].finish_reason
+
+
+@pytest.mark.quant
+@pytest.mark.parametrize("steps", [1, 3])
+def test_page_native_int8_arena_identity(nano, steps):
+    """int8 arenas in page-native mode (codes in the ``cache``
+    collection, scales in ``kvscale``; pages read-modify-requantized
+    per written token): token-identical to the int8 dense-gather
+    engine on the pinned trace. Unlike the full-precision case this is
+    an EMPIRICAL pin, not structural — page-native requantizes a page
+    per token where scatter_pages requantizes once per dispatch, so
+    multi-step dispatches (steps=3 here) accumulate extra bounded
+    rounding that must stay under these argmax margins."""
+    dec, params = nano
+    a = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                    page_size=4, kv_dtype="int8",
+                    steps_per_dispatch=steps)
+    ref = a.serve_trace(TRACE)
+    a.shutdown()
+    b = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                    page_size=4, kv_dtype="int8", page_native=True,
+                    steps_per_dispatch=steps)
+    out = b.serve_trace(TRACE)
+    b.shutdown()
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, (steps, rid)
+
+
+@pytest.mark.quant
+def test_page_native_chunked_prefix_compose(nano):
+    """Chunked prefill + prefix-cache adoption feed pages the
+    page-native step then reads through the table: identical tokens and
+    identical prefix_hit_tokens vs the dense-gather engine (the chunk
+    program itself still uses the bounded one-row view — only the
+    per-token hot path went page-native)."""
+    dec, params = nano
+    sysp = [11, 12, 13, 14, 15, 16, 17, 18]
+    trace = [(0, dict(prompt=sysp + [5, 17], max_new_tokens=5)),
+             (6, dict(prompt=sysp + [9], max_new_tokens=5)),
+             (8, dict(prompt=sysp + [42, 7, 3], max_new_tokens=5))]
+    kw = dict(num_slots=3, prefill_len=8, **PAGED)
+    a = ServeClient(dec, params, **kw)
+    ref = a.serve_trace(list(trace))
+    a.shutdown()
+    b = ServeClient(dec, params, page_native=True, **kw)
+    out = b.serve_trace(list(trace))
+    b.shutdown()
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].prefix_hit_tokens == ref[rid].prefix_hit_tokens
+    assert out[1].prefix_hit_tokens > 0
+
+
+@pytest.mark.quant
+def test_page_native_spec_identity(nano):
+    """Speculative decoding's widened verify also runs page-native
+    (reads/writes through the table): the spec + page-native engine
+    matches the plain dense engine token-for-token — spec identity and
+    page-native identity compose."""
+    import dataclasses
+    dec, params = nano
+    dcfg = dataclasses.replace(dec.cfg, n_layers=1)
+    draft = TransformerLM(dcfg)
+    dparams = TransformerLM(
+        dataclasses.replace(dcfg, decode=False)).init(
+        jax.random.PRNGKey(1), np.zeros((2, 4), np.int32))["params"]
+    base = ServeClient(dec, params, num_slots=3, prefill_len=8)
+    ref = base.serve_trace(TRACE)
+    base.shutdown()
+    spec = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                       page_size=4, page_native=True,
+                       draft_model=draft, draft_params=dparams,
+                       spec_k=2)
+    out = spec.serve_trace(TRACE)
+    spec.shutdown()
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+
+
+@pytest.mark.quant
+def test_page_native_crash_replay_identity(nano):
+    """Rebuild-and-replay over a page-native engine: the replayed
+    prefill re-seats pages and decode resumes through the table,
+    token-identical to the uninterrupted page-native run."""
+    dec, params = nano
+    a = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                    page_size=4, page_native=True)
+    ref = a.serve_trace(TRACE)
+    a.shutdown()
+    plan = FaultPlan.at("serve.dispatch", [4])
+    client = ServeClient(dec, params, num_slots=3, prefill_len=8,
+                         page_size=4, page_native=True,
+                         retry_policy=RetryPolicy(max_attempts=3,
+                                                  base_delay=0.0))
+    with plan.armed():
+        out = client.serve_trace(TRACE)
+    client.shutdown()
+    assert plan.fired == 1
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+
+
+@pytest.mark.quant
+def test_page_native_requires_paged(nano):
+    dec, params = nano
+    with pytest.raises(ValueError, match="page_native"):
+        ServeEngine(dec, params, prefill_len=8, page_native=True)
+
+
+@pytest.mark.quant
+def test_page_native_scanned_layers_int8(nano):
+    """Scanned-layer serving models work page-native too: the arena
+    (and, int8, the kvscale scales tree — whose bookkeeping
+    placeholders must mirror the per-layer leaf SHAPES, the regression
+    here: nn.scan slices every collection leaf along the layer axis)
+    rides the layer scan. Token-identical to the scanned dense-gather
+    engine."""
+    import dataclasses
+    dec_s = TransformerLM(dataclasses.replace(nano[0].cfg,
+                                              scan_layers=True))
+    from ray_lightning_tpu.models.transformer import stack_scan_params
+    params_s = stack_scan_params(nano[1])
+    for kw in (dict(), dict(kv_dtype="int8")):
+        a = ServeClient(dec_s, params_s, num_slots=2, prefill_len=8,
+                        page_size=4, **kw)
+        ref = a.serve_trace(TRACE)
+        a.shutdown()
+        b = ServeClient(dec_s, params_s, num_slots=2, prefill_len=8,
+                        page_size=4, page_native=True, **kw)
+        out = b.serve_trace(TRACE)
+        b.shutdown()
+        for rid in ref:
+            assert out[rid].tokens == ref[rid].tokens, (kw, rid)
