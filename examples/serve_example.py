@@ -44,6 +44,16 @@ def main():
                              "(multi-step scheduling: amortizes fixed "
                              "dispatch cost; joins/retires every K "
                              "tokens).")
+    parser.add_argument("--attention-kernel", default=None,
+                        choices=["xla", "pallas"],
+                        help="page-native attention read-side kernel "
+                             "(implies a paged page-native engine): "
+                             "'pallas' runs the hand-tiled paged-"
+                             "attention kernel (fused page gather + "
+                             "tiled softmax; interpret mode off-TPU), "
+                             "'xla' the blockwise XLA path. Greedy "
+                             "rows stay verified against generate() "
+                             "either way — the kernel is exact.")
     parser.add_argument("--weight-dtype", default=None,
                         choices=["int8", "int4"],
                         help="weight-only quantization: store params "
@@ -91,11 +101,19 @@ def main():
             temperature=0.0 if greedy else 0.8,
             top_k=None if greedy else 20)))
 
+    # --attention-kernel selects the page-native read-side kernel; the
+    # page-native layout it rides on needs a paged arena, so the flag
+    # implies page_size/page_native (16-token pages divide the example
+    # model's 64-token max_seq_len)
+    paged_kw = {}
+    if args.attention_kernel is not None:
+        paged_kw = dict(page_size=16, page_native=True,
+                        attention_kernel=args.attention_kernel)
     client = ServeClient(
         dec, params, num_slots=args.num_slots,
         prefill_len=args.prefill_len,
         steps_per_dispatch=args.steps_per_dispatch,
-        weight_dtype=args.weight_dtype,
+        weight_dtype=args.weight_dtype, **paged_kw,
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))
     t0 = time.perf_counter()

@@ -1,0 +1,234 @@
+"""Pallas paged-attention kernel: fused page gather + in-kernel int8
+dequant + tiled softmax on the decode/verify hot path.
+
+This is the hand-tiled half of the paged serving story
+(``docs/serving.md``): PR 11's page-native attention already reads and
+writes K/V through the page table in pure XLA, but that path still
+materializes page-sized score/output temporaries between HLO ops, and
+int8 KV codes are dequantized into compute-dtype blocks the compiler
+schedules as ordinary tensors. This kernel does the whole read side of
+cached attention in ONE ``pallas_call`` per layer, in the mold of
+PagedAttention (Kwon et al. 2023) with FlashAttention-style tiling
+(Dao et al. 2022):
+
+- **page-table-indexed block loads** — the page table is a
+  scalar-prefetch operand (``PrefetchScalarGridSpec``), so each grid
+  step's ``BlockSpec`` index map picks the ARENA page to stream into
+  VMEM directly from the table (unmapped −1 entries clamp to page 0,
+  the same finite-junk-the-mask-never-admits argument as the XLA
+  paths). Only occupied pages are ever touched; nothing shaped like
+  ``num_slots x max_seq_len`` exists anywhere.
+- **in-kernel int8 dequant** — int8 arenas stream CODES (int8) and
+  per-page-per-head scales (f32) through the block pipeline; the
+  ``codes x scales`` multiply happens on the (page_size, H, D) VMEM
+  block right before the dot. No dense dequantized K/V arena is ever
+  materialized — the only full-precision K/V in existence is one
+  page's worth of VMEM scratch per grid step.
+- **tiled softmax, f32 accumulators** — scores are computed blockwise
+  per page column (MXU dots with ``preferred_element_type=f32``) into
+  a VMEM-resident ``(H, T, max_seq_len)`` logits tile with the per-row
+  block-causal mask (``key_pos <= kv_positions[row, q]``) fused into
+  the same step; the softmax then runs ONCE, exactly, over the
+  completed tile (grid phase 2), and the output accumulates blockwise
+  over V page columns in f32. Exact softmax — not the online
+  approximation — is deliberate: it keeps the kernel's math
+  term-for-term identical to the XLA page-native path, which is what
+  lets the serve tests ENFORCE greedy token identity rather than fall
+  back to an agreement gate (see ``docs/serving.md`` for which config
+  gets which contract).
+
+Grid: ``(B, 2 * pages_per_slot)`` with the page axis innermost and
+sequential — steps ``0..pp-1`` score K pages, steps ``pp..2pp-1``
+accumulate V pages (the softmax fires on the first output step). The
+logits tile and the ``(H, T, D)`` accumulator live in VMEM scratch and
+persist across the inner grid, exactly the scheme
+``ops/pallas_flash.py`` uses. VMEM cost per slot is
+``H * T * max_seq_len`` f32 for the tile (a few hundred KB at serving
+shapes) — far under the ~16 MB budget.
+
+On hosts without a TPU the kernel runs under **pallas interpret mode**
+(the same lowering, executed by XLA CPU), which is how the CPU tier-1
+suite pins token identity; wall-clock there is honestly worse than the
+XLA path (interpretation tax), the byte floor is the claim
+(``bench.py`` ``extras["serve"]["pallas"]``, ``docs/performance.md``
+round 12).
+
+Engines select this path with ``ServeEngine(...,
+attention_kernel="pallas")`` on top of ``page_native=True`` — see
+``MultiHeadAttention._page_native_attention`` for the call site (the
+write half stays in XLA: T tokens' K/V land in their owning pages
+through the page table before the kernel reads).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_attention", "interpret_default"]
+
+_BIG_NEG = float(jnp.finfo(jnp.float32).min)
+
+
+def interpret_default() -> bool:
+    """Run the kernel in pallas interpret mode off-TPU (the CPU tier-1
+    correctness path); compile it for real on TPU backends."""
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+            logits_ref, acc_ref, *, page_size: int, pages_per_slot: int,
+            scale: float, compute_dtype):
+    """One grid step; see the module docstring for the two-phase plan.
+
+    ``ks_ref``/``vs_ref`` are None on full-precision arenas (the plain
+    wrapper below drops them from the signature — pallas passes refs
+    positionally).
+    """
+    j = pl.program_id(1)
+    pp = pages_per_slot
+    ps = page_size
+    T = q_ref.shape[1]
+
+    def load(ref, sref):
+        blk = ref[0]                                     # (ps, H, D)
+        if sref is None:
+            return blk
+        # kv_dequantize, blockwise: codes (int8) x per-page-per-head
+        # f32 scales -> compute dtype, on VMEM scratch only
+        return (blk.astype(jnp.float32) * sref[0]).astype(compute_dtype)
+
+    @pl.when(j < pp)
+    def _scores():
+        kb = load(k_ref, ks_ref)
+        qb = q_ref[0]                                    # (T, H, D)
+        s = jax.lax.dot_general(
+            qb, kb, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32)          # (H, T, ps)
+        s = s * scale
+        # per-row block-causal mask fused into the score step: page j
+        # covers absolute positions j*ps .. j*ps+ps-1
+        kpos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (T, ps), 1)
+        pos = pos_ref[0]                                 # (T,)
+        bias = jnp.where(kpos <= pos[:, None], 0.0, _BIG_NEG)
+        logits_ref[:, :, pl.ds(j * ps, ps)] = s + bias[None]
+
+    @pl.when(j == pp)
+    def _softmax():
+        # the tile is complete: ONE exact f32 softmax over every key
+        # position, term-for-term the XLA page-native path's
+        # jax.nn.softmax — weights overwrite the tile in place
+        lg = logits_ref[:]                               # (H, T, S)
+        w = jax.nn.softmax(lg, axis=-1)
+        all_masked = jnp.all(lg <= _BIG_NEG * 0.5, axis=-1, keepdims=True)
+        logits_ref[:] = jnp.where(all_masked, 0.0, w)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j >= pp)
+    def _accumulate():
+        jj = j - pp
+        vb = load(v_ref, vs_ref)
+        wb = logits_ref[:, :, pl.ds(jj * ps, ps)]        # (H, T, ps) f32
+        acc_ref[:] += jax.lax.dot_general(
+            wb.astype(compute_dtype), vb, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)          # (H, T, D)
+
+    @pl.when(j == 2 * pp - 1)
+    def _emit():
+        o_ref[0] = jnp.moveaxis(acc_ref[:], 0, 1).astype(o_ref.dtype)
+
+
+def _kernel_plain(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref, logits_ref,
+                  acc_ref, **kw):
+    _kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, None, None, o_ref,
+            logits_ref, acc_ref, **kw)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    k_scales: Optional[jax.Array],
+                    v_scales: Optional[jax.Array],
+                    kv_positions: jax.Array, page_table: jax.Array, *,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Cached paged attention for one layer's decode/verify read side.
+
+    - ``q`` (B, T, H, D) — T = 1 (decode step) or k+1 (spec verify).
+    - ``k_pages``/``v_pages`` (num_pages, page_size, H, D) — the arena
+      leaves (int8 codes when quantized; the block's own T tokens must
+      already be written — the caller's write half runs first).
+    - ``k_scales``/``v_scales`` (num_pages, 1, H, 1) f32 per-page
+      absmax scales, or None for full-precision arenas.
+    - ``kv_positions`` (B, T) — each row's absolute positions (the mask
+      admits ``key <= kv_positions[row, t]``, block-causal).
+    - ``page_table`` (B, pages_per_slot) int32, −1 = unmapped (reads
+      clamp to page 0; the mask never admits a position without a
+      mapped page on any row whose output is consumed).
+
+    Returns (B, T, H, D) in ``q.dtype``, matching the XLA page-native
+    path's output bit-for-bit up to per-block dot scheduling.
+    """
+    B, T, H, D = q.shape
+    ps = k_pages.shape[1]
+    pp = page_table.shape[1]
+    quantized = k_scales is not None
+    if interpret is None:
+        interpret = interpret_default()
+
+    page_table = page_table.astype(jnp.int32)
+    kv_positions = kv_positions.astype(jnp.int32)
+
+    def q_map(b, j, pt):
+        return (b, 0, 0, 0)
+
+    def pos_map(b, j, pt):
+        return (b, 0)
+
+    # K streams pages during the score phase and parks on its last page
+    # through the output phase (an unchanged block index is not
+    # re-fetched); V parks on the first output page through the score
+    # phase — each occupied page crosses HBM→VMEM once per pass.
+    def k_map(b, j, pt):
+        col = jnp.minimum(j, pp - 1)
+        return (jnp.maximum(pt[b, col], 0), 0, 0, 0)
+
+    def v_map(b, j, pt):
+        col = jnp.maximum(j - pp, 0)
+        return (jnp.maximum(pt[b, col], 0), 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, T), pos_map),
+        pl.BlockSpec((1, T, H, D), q_map),
+        pl.BlockSpec((1, ps, H, D), k_map),
+        pl.BlockSpec((1, ps, H, D), v_map),
+    ]
+    operands = [kv_positions, q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, H, 1), k_map),
+                     pl.BlockSpec((1, 1, H, 1), v_map)]
+        operands += [k_scales, v_scales]
+        kernel = _kernel
+    else:
+        kernel = _kernel_plain
+    kernel = functools.partial(
+        kernel, page_size=ps, pages_per_slot=pp, scale=D ** -0.5,
+        compute_dtype=q.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, 2 * pp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, T, H, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((H, T, pp * ps), jnp.float32),   # logits tile
+            pltpu.VMEM((H, T, D), jnp.float32),         # f32 accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        interpret=interpret,
+    )(page_table, *operands)

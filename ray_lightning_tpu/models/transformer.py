@@ -62,6 +62,14 @@ class TransformerConfig:
     # token per call (see models/generate.py)
     decode: bool = False
     attention_impl: str = "dot"      # dot | flash | ring | ulysses
+    # kernel for the PAGE-NATIVE cached-attention read side (serving
+    # engines with page_native=True; inert everywhere else): "xla" =
+    # the pure-XLA blockwise path, "pallas" = the hand-tiled paged
+    # attention kernel (models/pallas_attention.py — page-table-indexed
+    # block loads, in-kernel int8 dequant, tiled exact softmax; runs
+    # under pallas interpret mode off-TPU). Selected via
+    # ServeEngine/ServeClient(attention_kernel=...).
+    attention_kernel: str = "xla"    # xla | pallas
     # f32 (default) is the numerically-safe softmax; bf16 halves the
     # (B,H,T,T) score-tensor HBM traffic — +13% measured on the GPT-2
     # bench step (v5e) at ~1% attention-weight rounding. Only the 'dot'
@@ -81,6 +89,10 @@ class TransformerConfig:
                 "scan_unroll is set but scan_layers=False — the unroll "
                 "factor would be silently ignored (the python loop is "
                 "already fully unrolled); drop it or use scan_layers=True")
+        if self.attention_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"attention_kernel must be 'xla' or 'pallas', got "
+                f"{self.attention_kernel!r}")
         if self.remat_policy is not None:
             if not self.remat:
                 raise ValueError(
@@ -314,6 +326,14 @@ class MultiHeadAttention(nn.Module):
         - **accumulates** the output blockwise over V page columns in
           f32.
 
+        ``cfg.attention_kernel == "pallas"`` swaps the read side (the
+        three bullets above) for the hand-tiled pallas kernel
+        (:func:`ray_lightning_tpu.models.pallas_attention.paged_attention`)
+        — same blockwise plan, but the page loads, int8 dequant,
+        masked scores, exact softmax, and f32 output accumulation all
+        happen inside ONE kernel with VMEM-resident tiles (interpret
+        mode off-TPU). The write half below is shared by both kernels.
+
         Unmapped (−1) entries clamp to page 0 — finite stale bytes the
         position mask never admits, the same argument as
         ``gather_pages`` — and repeated clamped reads stay cache-hot:
@@ -395,6 +415,19 @@ class MultiHeadAttention(nn.Module):
                     kv_quantize(page, ns), mode="drop")
                 scales.value = scales.value.at[widx].set(ns,
                                                          mode="drop")
+
+        if cfg.attention_kernel == "pallas":
+            # fused read side: page-table-indexed block loads, int8
+            # dequant, masked blockwise scores, exact tiled softmax and
+            # f32 V accumulation in one pallas_call — bitwise-matching
+            # the XLA read below on the CPU interpret tier (pinned by
+            # tests/test_pallas_attention.py)
+            from ray_lightning_tpu.models.pallas_attention import (
+                paged_attention)
+            return paged_attention(
+                q, ck.value, cv.value,
+                sk.value if quantized else None,
+                sv.value if quantized else None, pos, page_table)
 
         # ---- scores blockwise over page columns, ONE exact softmax
         scale = cfg.head_dim ** -0.5

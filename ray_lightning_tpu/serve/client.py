@@ -53,6 +53,7 @@ class ServeClient:
                  prefix_cache: bool = False,
                  kv_dtype: Optional[str] = None,
                  page_native: bool = False,
+                 attention_kernel: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  weight_group_size: Optional[int] = None,
                  draft_model=None, draft_params=None,
@@ -65,7 +66,8 @@ class ServeClient:
             telemetry=telemetry, page_size=page_size,
             num_pages=num_pages, prefill_chunk=prefill_chunk,
             prefix_cache=prefix_cache, kv_dtype=kv_dtype,
-            page_native=page_native, weight_dtype=weight_dtype,
+            page_native=page_native, attention_kernel=attention_kernel,
+            weight_dtype=weight_dtype,
             weight_group_size=weight_group_size,
             draft_model=draft_model, draft_params=draft_params,
             spec_k=spec_k, draft_weight_dtype=draft_weight_dtype)
@@ -95,6 +97,15 @@ class ServeClient:
         # attribute read + None check per tick, nothing else
         self._tel = telemetry
         self.num_slots = num_slots
+        # name prefix for this client's occupancy gauges
+        # (serve_queue_depth / serve_slot_occupancy / serve_pages_free /
+        # serve_page_occupancy). "" for a standalone client keeps the
+        # historical names; a ReplicaFleet stamps each replica's client
+        # with a stable "replica<id>_" prefix so per-replica gauges
+        # stop clobbering each other in the shared name-keyed registry
+        # (docs/observability.md). Counters and histograms stay
+        # unprefixed — they aggregate correctly across writers.
+        self.gauge_prefix = ""
 
     # ------------------------------------------------------------ clock
     @property
@@ -146,7 +157,7 @@ class ServeClient:
                 "serve_requests_total",
                 help="requests accepted by admission control").inc()
             tel.metrics.gauge(
-                "serve_queue_depth",
+                self.gauge_prefix + "serve_queue_depth",
                 help="requests waiting in the scheduler queue"
             ).set(len(self.scheduler))
         return req.id
@@ -365,19 +376,19 @@ class ServeClient:
                         "(client clock units)").observe(
                         (comp.finish_time - comp.first_token_time)
                         / (len(comp.tokens) - 1))
-        m.gauge("serve_queue_depth",
+        m.gauge(self.gauge_prefix + "serve_queue_depth",
                 help="requests waiting in the scheduler queue"
                 ).set(len(self.scheduler))
-        m.gauge("serve_slot_occupancy",
+        m.gauge(self.gauge_prefix + "serve_slot_occupancy",
                 help="fraction of KV slots holding an in-flight request"
                 ).set(self.engine.active_count / self.num_slots)
         pages_free = getattr(self.engine, "free_pages", None)
         if pages_free is not None:
             num_pages = self.engine.pool.num_pages
-            m.gauge("serve_pages_free",
+            m.gauge(self.gauge_prefix + "serve_pages_free",
                     help="free KV pages in the paged arena"
                     ).set(pages_free)
-            m.gauge("serve_page_occupancy",
+            m.gauge(self.gauge_prefix + "serve_page_occupancy",
                     help="fraction of arena pages held (slots + prefix "
                     "cache)").set(1.0 - pages_free / num_pages)
 

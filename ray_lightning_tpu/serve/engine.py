@@ -50,7 +50,11 @@ dequantized ONCE at each program's entry — the at-rest param stream
 dense-view gather/scatter for attention that reads and writes K/V
 straight through the page table inside the model — dispatch bytes
 scale with *occupied* pages, token-identical to the dense-gather path
-(see ``docs/serving.md``).
+(see ``docs/serving.md``). ``attention_kernel="pallas"`` further swaps
+that read side for the hand-tiled pallas paged-attention kernel
+(``models/pallas_attention.py``): page loads, int8 dequant, masked
+blockwise scores, exact tiled softmax and f32 output accumulation all
+fused in one kernel — interpret mode off-TPU, identical tokens.
 
 KV layout is split from the programs (the refactor ROADMAP item 1 calls
 healthy): the *logical* per-slot ``(max_seq_len, H, D)`` KV each program
@@ -62,8 +66,8 @@ is a ``(num_pages, page_size, H, D)`` arena per KV leaf plus a per-slot
 page table; the programs stay the same fixed-shape jits — the page
 table is just a gather index applied on the way in and a scatter index
 on the way out, fused into the dispatch. See ``docs/serving.md`` for
-the memory/bandwidth trade and the production endgame (gather folded
-into a pallas paged-attention kernel).
+the memory/bandwidth trade; the old "pallas kernel endgame" there is
+landed as ``attention_kernel="pallas"``.
 
 Inactive slots still flow through the step program (the batch is
 static); they are masked out of sampling/bookkeeping and their parked
@@ -74,6 +78,7 @@ because slots no longer reserve memory.
 """
 from __future__ import annotations
 
+import dataclasses
 import warnings
 from collections import deque
 from dataclasses import dataclass
@@ -531,6 +536,9 @@ class ServeEngine:
     (``weight_group_size=`` sizes the int4 groups) and
     ``page_native=True`` drops the paged dispatch's dense-view
     round-trip — all four compose, with each other and with spec.
+    ``attention_kernel="pallas"`` (requires ``page_native=True``) runs
+    the page-native read side as one hand-tiled pallas kernel per
+    layer instead of blockwise XLA — same tokens, fewer temporaries.
 
     Drive it with :class:`~ray_lightning_tpu.serve.client.ServeClient`
     (scheduler + admission control + clocks) or directly:
@@ -549,6 +557,7 @@ class ServeEngine:
                  prefix_cache: bool = False,
                  kv_dtype: Optional[str] = None,
                  page_native: bool = False,
+                 attention_kernel: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  weight_group_size: Optional[int] = None,
                  draft_model=None, draft_params=None,
@@ -565,6 +574,30 @@ class ServeEngine:
             raise ValueError(
                 "page_native=True is a paged-KV mode (attention reads "
                 "K/V through the page table): pass page_size= too")
+        # attention_kernel selects the page-native read-side kernel
+        # (models/pallas_attention.py): None inherits the model config
+        # (default "xla"); "pallas" swaps in the hand-tiled paged
+        # kernel. A config mismatch rebuilds the model with the
+        # requested kernel — the cfg field is the single source of
+        # truth the attention dispatches on, so supervisor rebuilds and
+        # fleet replicas (which re-enter this ctor with the same
+        # kwargs) select the identical programs.
+        if attention_kernel not in (None, "xla", "pallas"):
+            raise ValueError(
+                f"attention_kernel must be None, 'xla' or 'pallas', "
+                f"got {attention_kernel!r}")
+        if attention_kernel is not None \
+                and attention_kernel != cfg.attention_kernel:
+            model = model.clone(cfg=dataclasses.replace(
+                cfg, attention_kernel=attention_kernel))
+            cfg = model.cfg
+        self.attention_kernel = cfg.attention_kernel
+        if self.attention_kernel == "pallas" and not page_native:
+            raise ValueError(
+                "attention_kernel='pallas' is the page-native paged-"
+                "attention kernel (K/V stream through the page table "
+                "inside one pallas_call): pass page_native=True (and "
+                "page_size=) too")
         check_weight_dtype(weight_dtype)  # unknown dtypes refused here
         check_weight_dtype(draft_weight_dtype)
         if weight_group_size is not None \

@@ -338,6 +338,14 @@ class _Replica:
     def __init__(self, replica_id: int, client: ServeClient):
         self.id = replica_id
         self.client = client
+        # per-replica gauge keying: every replica writes its occupancy
+        # gauges into the ONE shared name-keyed registry, so without a
+        # replica-id prefix they clobber each other last-writer-wins
+        # (the old docs/observability.md caveat). The id is stable for
+        # the replica's whole life, so `replica<id>_serve_*` series
+        # stay coherent across failovers; a standby promoted here gets
+        # its prefix at adoption time, before its first dispatch.
+        client.gauge_prefix = f"replica{replica_id}_"
         self.draining = False   # scale-in: finish in-flight, admit nothing
         self.stalled = False    # latched wedge (serve.replica stall fault)
         # carried beat state: the monitor is rebuilt on membership

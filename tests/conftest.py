@@ -88,6 +88,46 @@ def pytest_configure(config):
         "page-native attention (QTensor storage, pack/unpack, "
         "param-byte accounting, page-table-direct KV) — "
         "`pytest -m quant` runs it as a fast targeted subset")
+    config.addinivalue_line(
+        "markers", "pallas: the hand-tiled pallas paged-attention "
+        "kernel (attention_kernel='pallas': fused page gather + "
+        "in-kernel int8 dequant + tiled softmax, interpret mode on "
+        "this tier) — `pytest -m pallas` runs it as a fast targeted "
+        "subset")
+
+
+@pytest.fixture(scope="session")
+def serve_nano_family():
+    """The ONE pinned serve-family nano pair (gpt2-nano target at
+    vocab 128 / max_seq_len 32 / f32 / unrolled layers, + a 1-layer
+    draft sharing vocab/max_seq_len), shared session-wide by the
+    heaviest serve modules (test_paged / test_spec / test_quant /
+    test_pallas_attention). One construction instead of four keeps
+    init work deduped, and — the part the tier-1 cold-compile wall
+    actually cares about — pins every module's engines to the SAME
+    model hash, so their fixed-shape programs share one jit-cache
+    entry per shape (the ROADMAP timeout sizing note). Returns
+    ``(dec, params, draft, dparams)``; paged-only consumers slice
+    ``[:2]``."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_lightning_tpu.models import TransformerLM, gpt2_config
+    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    dcfg = dataclasses.replace(gpt2_config("nano", decode=True, **mk),
+                               n_layers=1)
+    draft = TransformerLM(dcfg)
+    dparams = TransformerLM(
+        dataclasses.replace(dcfg, decode=False)).init(
+        jax.random.PRNGKey(1), np.zeros((2, 4), np.int32))["params"]
+    return dec, params, draft, dparams
 
 
 @pytest.fixture(autouse=True)

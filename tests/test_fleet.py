@@ -682,3 +682,29 @@ def test_router_shutdown_clears_state(nano):
     fleet.shutdown()
     assert not fleet.router._affinity and not fleet.router._ttft
     router.shutdown()  # standalone router: idempotent no-op
+
+
+def test_replica_gauges_keyed_by_replica_id(nano):
+    """Per-replica occupancy gauges must not clobber each other in the
+    shared name-keyed registry: every replica's client writes
+    `replica<id>_serve_*` series (stable id prefix), and no replica
+    writes the bare single-client names — the old last-writer-wins
+    caveat in docs/observability.md, fixed."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_slots=2,
+                         prefill_len=8, telemetry=tel)
+    for i in range(4):
+        fleet.submit([5, 17, 3], max_new_tokens=3, seed=i)
+    fleet.run_until_idle()
+    snap = tel.metrics.snapshot()
+    for rep in fleet._replicas:
+        assert rep.client.gauge_prefix == f"replica{rep.id}_"
+        for base in ("serve_queue_depth", "serve_slot_occupancy"):
+            assert f"replica{rep.id}_{base}" in snap, (rep.id, base)
+    # the bare names stay reserved for standalone clients
+    assert "serve_queue_depth" not in snap
+    assert "serve_slot_occupancy" not in snap
+    # fleet-truth gauges unchanged
+    assert snap["serve_fleet_replicas_live"] == 2
+    fleet.shutdown()

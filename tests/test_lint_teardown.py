@@ -43,6 +43,13 @@ RESOURCE_FACTORIES = {
     # KV cache (device memory) — released via the owning engine's
     # shutdown()
     "SpecDecoder",
+    # kernel factories: anything that builds/caches compiled pallas
+    # paged-attention callables. The current kernel entry point
+    # (models/pallas_attention.py paged_attention) is a pure function —
+    # nothing is held — but a class caching its pallas_call closures
+    # (or executables) would pin device programs past its engine, so
+    # the factory names are covered up front
+    "paged_attention", "PagedAttentionKernel",
 }
 
 RELEASE_METHODS = {"shutdown", "close", "_kill", "kill"}

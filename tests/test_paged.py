@@ -13,11 +13,10 @@ occupancy-context errors shed-load callers log.
 import warnings
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.models import TransformerLM
 from ray_lightning_tpu.models.generate import generate
 from ray_lightning_tpu.obs import Telemetry
 from ray_lightning_tpu.reliability import FaultPlan, RetryPolicy
@@ -32,13 +31,10 @@ PAGED = dict(page_size=4, prefill_chunk=8, prefix_cache=True)
 
 
 @pytest.fixture(scope="module")
-def nano():
-    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
-              scan_layers=False)
-    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
-    params = TransformerLM(gpt2_config("nano", **mk)).init(
-        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
-    return dec, params
+def nano(serve_nano_family):
+    # the shared serve-family pair (conftest): one model hash across
+    # the heavy serve modules = shared compiled programs per shape
+    return serve_nano_family[:2]
 
 
 def _ref_windows(dec, params, prompts, n, eos_id=None):

@@ -20,14 +20,12 @@ The load-bearing assertions:
   ``weight_dtype="int4"`` + page-native attention all stack on one
   engine and match the same-quantized plain engine token-for-token.
 """
-import dataclasses
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ray_lightning_tpu.models import TransformerLM, gpt2_config
 from ray_lightning_tpu.models.quant import (QTensor, dequantize_params,
                                             is_quantized, pack_int4,
                                             param_bytes, quantize_params,
@@ -45,20 +43,10 @@ GS = 8
 
 
 @pytest.fixture(scope="module")
-def nano():
-    """Target (gpt2-nano, f32 — real argmax margins) + 1-layer draft."""
-    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
-              scan_layers=False)
-    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
-    params = TransformerLM(gpt2_config("nano", **mk)).init(
-        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
-    dcfg = dataclasses.replace(gpt2_config("nano", decode=True, **mk),
-                               n_layers=1)
-    draft = TransformerLM(dcfg)
-    dparams = TransformerLM(
-        dataclasses.replace(dcfg, decode=False)).init(
-        jax.random.PRNGKey(1), np.zeros((2, 4), np.int32))["params"]
-    return dec, params, draft, dparams
+def nano(serve_nano_family):
+    """Target (gpt2-nano, f32 — real argmax margins) + 1-layer draft
+    — the shared serve-family pair (conftest)."""
+    return serve_nano_family
 
 
 PROMPTS = [[5, 17, 3, 9], [9, 2, 44], [42, 7], [1]]

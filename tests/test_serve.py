@@ -11,11 +11,9 @@ deadline expiry — all CPU-safe on the nano GPT config with scripted
 (tick-clock) arrival traces.
 """
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ray_lightning_tpu.models import TransformerLM, gpt2_config
 from ray_lightning_tpu.models.generate import generate
 from ray_lightning_tpu.serve import (FINISH_EOS, FINISH_LENGTH,
                                      FINISH_REJECTED, FINISH_TIMEOUT,
@@ -28,13 +26,10 @@ pytestmark = pytest.mark.serve
 
 
 @pytest.fixture(scope="module")
-def nano():
-    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
-              scan_layers=False)
-    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
-    params = TransformerLM(gpt2_config("nano", **mk)).init(
-        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
-    return dec, params
+def nano(serve_nano_family):
+    # the shared serve-family pair (conftest): one model hash across
+    # the heavy serve modules = shared compiled programs per shape
+    return serve_nano_family[:2]
 
 
 def _ref_windows(dec, params, prompts, n, eos_id=None):

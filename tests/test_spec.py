@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.models import TransformerLM
 from ray_lightning_tpu.obs import Telemetry
 from ray_lightning_tpu.reliability import FaultPlan, FaultSpec, RetryPolicy
 from ray_lightning_tpu.serve import (FINISH_EOS, FINISH_LENGTH,
@@ -40,20 +40,10 @@ pytestmark = [pytest.mark.serve, pytest.mark.spec]
 
 
 @pytest.fixture(scope="module")
-def nano():
-    """Target (gpt2-nano) + a 1-layer draft sharing vocab/max_seq_len."""
-    mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
-              scan_layers=False)
-    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
-    params = TransformerLM(gpt2_config("nano", **mk)).init(
-        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
-    dcfg = dataclasses.replace(gpt2_config("nano", decode=True, **mk),
-                               n_layers=1)
-    draft = TransformerLM(dcfg)
-    dparams = TransformerLM(
-        dataclasses.replace(dcfg, decode=False)).init(
-        jax.random.PRNGKey(1), np.zeros((2, 4), np.int32))["params"]
-    return dec, params, draft, dparams
+def nano(serve_nano_family):
+    """Target (gpt2-nano) + a 1-layer draft sharing vocab/max_seq_len
+    — the shared serve-family pair (conftest)."""
+    return serve_nano_family
 
 
 PROMPTS = [[5, 17, 3, 9], [9, 2, 44], [42, 7], [1]]
