@@ -54,6 +54,13 @@ def main():
                              "'xla' the blockwise XLA path. Greedy "
                              "rows stay verified against generate() "
                              "either way — the kernel is exact.")
+    parser.add_argument("--async-dispatch", action="store_true",
+                        help="depth-2 pipelined dispatch: enqueue the "
+                             "next decode dispatch before syncing the "
+                             "previous one's tokens — host work "
+                             "overlaps the in-flight dispatch, tokens "
+                             "stay identical to the sync driver "
+                             "(docs/serving.md#async-dispatch).")
     parser.add_argument("--weight-dtype", default=None,
                         choices=["int8", "int4"],
                         help="weight-only quantization: store params "
@@ -113,6 +120,7 @@ def main():
         dec, params, num_slots=args.num_slots,
         prefill_len=args.prefill_len,
         steps_per_dispatch=args.steps_per_dispatch,
+        async_dispatch=args.async_dispatch,
         weight_dtype=args.weight_dtype, **paged_kw,
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))

@@ -11,8 +11,8 @@ See ``docs/serving.md`` for the architecture. Quick start::
     print(out.tokens, out.finish_reason)
 """
 from ray_lightning_tpu.serve.client import ServeClient
-from ray_lightning_tpu.serve.engine import (KVSlotPool, ServeEngine,
-                                            SlotPoolFull)
+from ray_lightning_tpu.serve.engine import (KVSlotPool, PendingDispatch,
+                                            ServeEngine, SlotPoolFull)
 from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetSaturated,
                                            ReplicaFleet, Router,
                                            RouterConfig)
@@ -27,7 +27,8 @@ from ray_lightning_tpu.serve.spec import SpecDecoder
 
 __all__ = [
     "ServeClient", "ServeEngine", "KVSlotPool", "PagePool", "PrefixCache",
-    "SlotPoolFull", "SpecDecoder", "Request", "Completion",
+    "PendingDispatch", "SlotPoolFull", "SpecDecoder", "Request",
+    "Completion",
     "FifoScheduler", "QueueFull", "SchedulerConfig", "ReplicaFleet",
     "Router", "RouterConfig", "FleetConfig", "FleetSaturated",
     "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",

@@ -75,10 +75,18 @@ KV rewrite is idempotent (dense) or dropped by the scatter (paged), so
 they cost FLOPs but never correctness. Keep ``num_slots`` near your
 live-traffic working set — paged engines can afford a generous batch
 because slots no longer reserve memory.
+
+The step/spec dispatch additionally splits into an **async seat**
+(``step_enqueue()`` → :class:`PendingDispatch` → ``step_sync()``):
+the device carry chains dispatch-to-dispatch without touching the
+host, so ``ServeClient(async_dispatch=True)`` overlaps all host work
+with the in-flight dispatch — see the class docs and
+``docs/serving.md#async-dispatch``.
 """
 from __future__ import annotations
 
 import dataclasses
+import time
 import warnings
 from collections import deque
 from dataclasses import dataclass
@@ -119,7 +127,41 @@ from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
                                              FINISH_LENGTH, FINISH_TIMEOUT,
                                              Request)
 
-__all__ = ["ServeEngine", "KVSlotPool", "SlotPoolFull"]
+__all__ = ["ServeEngine", "KVSlotPool", "SlotPoolFull", "PendingDispatch"]
+
+
+@dataclass
+class PendingDispatch:
+    """Deferred-sync handle for one enqueued step / spec-round dispatch.
+
+    :meth:`ServeEngine.step_enqueue` returns one of these instead of
+    blocking on the host copies: ``emitted``/``finished`` (and the spec
+    accept ledgers) are still device arrays — futures under JAX's async
+    dispatch — and ``carry`` is the device-side engine state
+    (cur/pos/active/remaining/stepno) the NEXT enqueue chains on, so a
+    second STEP dispatch can launch before this one's tokens ever touch
+    the host. :meth:`ServeEngine.step_sync` materializes the handle:
+    the host copy (THE blocking point), the retire loop, counters and
+    telemetry. Handles must sync in enqueue order; an engine rebuild
+    (crash recovery, fleet failover) DISCARDS outstanding handles — the
+    synced frontier is the replay truth, an in-flight speculative
+    dispatch is regenerated by replay, never committed twice
+    (``docs/serving.md#async-dispatch``).
+    """
+    kind: str          # "step" | "spec"
+    dispatch: int      # engine.steps at enqueue (1-based)
+    rounds: int        # steps_per_dispatch scanned inside the program
+    emitted: object    # (rounds, B) or (rounds, B, k+1) device array
+    finished: object   # (rounds, B) device array
+    carry: tuple       # (cur, pos, active, remaining, stepno) on device
+    owner: object = None       # identity nonce of the issuing engine —
+    #                            a rebuilt engine refuses foreign
+    #                            handles even when dispatch indices
+    #                            realign (e.g. both at 1)
+    accepted: object = None    # spec only: (rounds, B) draft credits
+    rejected: object = None    # spec only: (rounds, B) real divergences
+    asynchronous: bool = True  # False: the sync step() round-trip
+    enqueued_at: float = 0.0   # host perf_counter stamp (overlap metric)
 
 
 # shared serve-program plumbing (one copy for engine + spec programs)
@@ -740,6 +782,28 @@ class ServeEngine:
         self._keys = np.zeros((B, 2), np.uint32)
         self._stepno = np.zeros((B,), np.int32)
         self._tokens: Dict[int, List[int]] = {}
+        # deferred-carry seat (async dispatch): the numpy fields above
+        # always hold the SYNCED frontier — the newest dispatch whose
+        # tokens the host has seen. When a dispatch is enqueued but not
+        # yet synced, its device-side outputs live here and the next
+        # enqueue chains on them; step_sync catches the frontier up and
+        # clears it. None = fully synced, barrier dispatches allowed.
+        self._carry: Optional[tuple] = None
+        # highest step-dispatch index step_sync has committed — the
+        # in-order guard: handles sync exactly once, in enqueue order,
+        # and a rebuilt-away engine's handle (its index can't be the
+        # fresh engine's next) fails loudly instead of corrupting
+        self._synced_dispatch = 0
+        # sync step()'s retry seat: a handle whose host copy failed
+        # after its dispatch launched (step_sync left the engine
+        # untouched, so the next step() retries the SAME sync instead
+        # of wedging behind _require_synced with the handle lost)
+        self._retry_sync: Optional[PendingDispatch] = None
+        # identity nonce stamped into every handle: step_sync refuses a
+        # handle another engine issued — the dispatch-index guard alone
+        # has a realignment hole (a dead engine's dispatch-1 handle
+        # matches a fresh engine's expected 1)
+        self._engine_token = object()
 
         # counters for the bench / scheduler policy (steps counts
         # dispatches; decode_substeps counts target-model param-read
@@ -808,6 +872,53 @@ class ServeEngine:
     def chunk_pending(self) -> int:
         """Prompts admitted but still streaming through chunk prefill."""
         return len(self._chunk_queue)
+
+    @property
+    def carry_deferred(self) -> bool:
+        """True while an enqueued dispatch's device carry has not been
+        synced back to the host (an outstanding
+        :class:`PendingDispatch` must be ``step_sync``-ed)."""
+        return self._carry is not None
+
+    @property
+    def retry_pending(self) -> bool:
+        """True while a failed sync step's handle waits in the retry
+        seat — the next :meth:`step` drains it before dispatching anew
+        (the sync driver's tick does this ahead of any barrier, so a
+        transient host-copy error cannot wedge deadline cancels or
+        admissions)."""
+        return self._retry_sync is not None
+
+    @property
+    def spec_needs_refill(self) -> bool:
+        """True when the next spec dispatch must rebuild draft KV from
+        host-side token streams (stale slots — fresh admits, final
+        chunks, crash replays). The async client drains its pipeline
+        first, so the refill always reads the synced stream."""
+        return self.spec is not None and bool(self.spec.stale)
+
+    def _require_synced(self, op: str) -> None:
+        """Barrier dispatches (admission, chunk, cancel) mutate the
+        host-side row state in place — they need the synced frontier,
+        or the next enqueue would chain on stale device carry and drop
+        the mutation. The async client drains before every barrier;
+        this guard makes direct misuse loud instead of corrupting."""
+        if self._carry is not None:
+            raise RuntimeError(
+                f"{op} needs the synced frontier but an enqueued "
+                "dispatch is still pending — step_sync() the "
+                "outstanding PendingDispatch first (ServeClient"
+                "(async_dispatch=True) drains its pipeline before "
+                "admission/chunk/cancel dispatches)")
+
+    def _carry_in(self) -> tuple:
+        """The row-state arrays the next dispatch consumes: the device
+        carry of the newest enqueued dispatch when one is outstanding
+        (pipelined chaining), else the synced numpy frontier."""
+        if self._carry is not None:
+            return self._carry
+        return (self._cur, self._pos, self._active, self._remaining,
+                self._stepno)
 
     @property
     def chunk_pending_ids(self) -> FrozenSet[int]:
@@ -942,6 +1053,7 @@ class ServeEngine:
         """
         if not requests:
             return []
+        self._require_synced("prefill")
         faults.fire("serve.dispatch")
         n_batched = sum(not self._routes_chunked(r) for r in requests)
         if n_batched > self.prefill_batch \
@@ -1086,6 +1198,7 @@ class ServeEngine:
         self.chunk_activated = None
         if not self._chunk_queue:
             return []
+        self._require_synced("prefill_chunk_step")
         faults.fire("serve.dispatch")
         st = self._chunk_queue[0]
         req = st.request
@@ -1177,13 +1290,54 @@ class ServeEngine:
         Speculative engines (``draft_model=``) route here too: each of
         the ``steps_per_dispatch`` scanned units is then one spec ROUND
         (k draft steps + one widened verify) committing 1..k+1 tokens
-        per row instead of exactly one."""
-        if not self._active.any():
-            return []
+        per row instead of exactly one.
+
+        Internally this is :meth:`step_enqueue` + :meth:`step_sync`
+        back-to-back — the sync driver pays the host round-trip between
+        every dispatch; ``ServeClient(async_dispatch=True)`` splits the
+        halves across ticks so the device never waits on it. With a
+        handle still outstanding this refuses loudly (same misuse class
+        as the barrier guards): chaining a sync step past an un-synced
+        enqueue would advance the carry while silently dropping the
+        outstanding dispatch's tokens. A transient device error at the
+        host copy is retryable: the failed sync leaves the engine
+        untouched and the handle parks in a retry seat, so the next
+        ``step()`` syncs the SAME dispatch before launching anew."""
+        if self._retry_sync is not None:
+            # a prior step()'s sync failed after its dispatch launched —
+            # drain it first (the carry is deliberately still deferred)
+            pending, self._retry_sync = self._retry_sync, None
+        else:
+            self._require_synced("step")
+            pending = self._enqueue(asynchronous=False)
+            if pending is None:
+                return []
+        try:
+            return self.step_sync(pending)
+        except Exception:
+            self._retry_sync = pending
+            raise
+
+    def step_enqueue(self) -> Optional[PendingDispatch]:
+        """Enqueue one step/spec dispatch against the device carry and
+        return WITHOUT syncing its outputs (depth-2 pipelining: the
+        returned :class:`PendingDispatch` is reconciled by
+        :meth:`step_sync` while the NEXT dispatch computes). Rows that
+        retire inside an un-synced dispatch are handled by the
+        in-program latches the next dispatch already carries — parked
+        rows emit −1 and write nothing — so chained enqueues commit
+        exactly the sync driver's tokens. Returns ``None`` when nothing
+        is in flight at the synced frontier and no carry is deferred."""
+        return self._enqueue(asynchronous=True)
+
+    def _enqueue(self, *, asynchronous: bool) -> Optional[PendingDispatch]:
+        if self._carry is None and not self._active.any():
+            return None
         if self.spec is not None:
-            return self._spec_step()
+            return self._spec_enqueue(asynchronous)
         faults.fire("serve.dispatch")
         tel = self._tel
+        cur, pos, active, remaining, stepno = self._carry_in()
         with (tel.span("engine.step", active=int(self._active.sum()))
               if tel is not None else NULL_SPAN):
             if self.paged and self.page_native:
@@ -1193,15 +1347,20 @@ class ServeEngine:
                 # the dense-gather path up to reduction-order rounding
                 # (int8 arenas: plus per-token page requant rounding —
                 # docs/serving.md caveat); pinned by tests/test_paged.py
-                # and the bench's enforced 0-mismatch gate.
+                # and the bench's enforced 0-mismatch gate. The write
+                # mask comes from the SYNCED frontier: a row that
+                # retired inside a still-pending dispatch keeps its
+                # entries one extra dispatch and re-writes its frozen
+                # K/V idempotently — its pages are only released (and
+                # only reusable) at sync, behind the admission barrier.
                 fn = _pick(_page_native_step_donated,
                            _page_native_step_plain)
                 (self.pool.arena, cur, pos, active, remaining, stepno,
                  emitted, finished) = fn(
                     self.model, self.params, self.pool.arena,
-                    self._write_masked_table(), self._cur, self._pos,
-                    self._active, self._remaining, self._temp,
-                    self._top_k, self._eos, self._keys, self._stepno,
+                    self._write_masked_table(), cur, pos,
+                    active, remaining, self._temp,
+                    self._top_k, self._eos, self._keys, stepno,
                     steps=self.steps_per_dispatch)
             elif self.paged:
                 fn = _pick(_paged_step_donated, _paged_step_plain)
@@ -1212,27 +1371,88 @@ class ServeEngine:
                 (self.pool.arena, cur, pos, active, remaining, stepno,
                  emitted, finished) = fn(
                     self.model, self.params, self.pool.arena,
-                    np.array(self.pool.page_table), self._cur, self._pos,
-                    self._active, self._remaining, self._temp,
-                    self._top_k, self._eos, self._keys, self._stepno,
+                    np.array(self.pool.page_table), cur, pos,
+                    active, remaining, self._temp,
+                    self._top_k, self._eos, self._keys, stepno,
                     steps=self.steps_per_dispatch)
             else:
                 fn = _pick(_engine_step_donated, _engine_step_plain)
                 (self.pool.cache, cur, pos, active, remaining, stepno,
                  emitted, finished) = fn(
-                    self.model, self.params, self.pool.cache, self._cur,
-                    self._pos, self._active, self._remaining, self._temp,
-                    self._top_k, self._eos, self._keys, self._stepno,
+                    self.model, self.params, self.pool.cache, cur,
+                    pos, active, remaining, self._temp,
+                    self._top_k, self._eos, self._keys, stepno,
                     steps=self.steps_per_dispatch)
-        # np.array (copy): jax outputs view as read-only buffers, and the
-        # next prefill writes these rows in place
-        self._cur = np.array(cur)
-        self._pos = np.array(pos)
-        self._active = np.array(active)
-        self._remaining = np.array(remaining)
-        self._stepno = np.array(stepno)
-        emitted = np.asarray(emitted)      # (steps, B), −1 = parked row
-        finished = np.asarray(finished)    # (steps, B)
+        self._carry = (cur, pos, active, remaining, stepno)
+        self.steps += 1
+        self.decode_substeps += self.steps_per_dispatch
+        if tel is not None and asynchronous:
+            tel.event("engine.dispatch_enqueued", dispatch=self.steps,
+                      kind="step")
+        return PendingDispatch(
+            kind="step", dispatch=self.steps,
+            rounds=self.steps_per_dispatch, emitted=emitted,
+            finished=finished, carry=self._carry,
+            owner=self._engine_token,
+            asynchronous=asynchronous, enqueued_at=time.perf_counter())
+
+    def step_sync(self, pending: PendingDispatch) -> List[Completion]:
+        """Materialize one enqueued dispatch: copy its outputs to the
+        host (THE blocking point — everything the caller did since
+        :meth:`step_enqueue` overlapped the device), catch the synced
+        frontier up to its carry, and run the retire loop. Handles must
+        sync in enqueue order; a handle from a rebuilt-away engine must
+        be DISCARDED, never synced (its tokens were regenerated by
+        replay)."""
+        if pending.owner is not self._engine_token:
+            raise RuntimeError(
+                "step_sync on a foreign handle: this PendingDispatch "
+                "was issued by another (likely rebuilt-away) engine — "
+                "it must be discarded, never synced; its tokens were "
+                "regenerated by the replay")
+        if pending.dispatch != self._synced_dispatch + 1:
+            # same loud-misuse policy as _require_synced: a double sync
+            # would duplicate every emitted token (and could retire a
+            # slot's NEW tenant on the old row's verdict), and a handle
+            # from a rebuilt-away engine must be discarded, never
+            # synced — both show up here as an out-of-order index
+            raise RuntimeError(
+                f"step_sync out of order: handle is dispatch "
+                f"{pending.dispatch}, engine expects "
+                f"{self._synced_dispatch + 1} — handles sync exactly "
+                "once, in enqueue order, and a rebuilt engine's "
+                "outstanding handle must be discarded, not synced")
+        tel = self._tel
+        overlap_ms = 1e3 * (time.perf_counter() - pending.enqueued_at)
+        # materialize EVERY fallible host copy into locals first: a
+        # device error surfacing here must leave the engine untouched —
+        # the caller keeps the handle and can retry this same sync (or
+        # hit the loud out-of-order guard), instead of resuming past a
+        # dispatch whose tokens were silently skipped
+        cur, pos, active, remaining, stepno = pending.carry
+        # np.array (copy): jax outputs view as read-only buffers, and
+        # the next prefill writes these rows in place
+        cur = np.array(cur)
+        pos = np.array(pos)
+        active = np.array(active)
+        remaining = np.array(remaining)
+        stepno = np.array(stepno)
+        emitted = np.asarray(pending.emitted)  # (steps, B), −1 = parked
+        finished = np.asarray(pending.finished)  # (steps, B)
+        if pending.kind == "spec":
+            accepted = np.asarray(pending.accepted)   # (rounds, B)
+            rejected = np.asarray(pending.rejected)   # (rounds, B)
+        # ---- commit point: everything below is host-side bookkeeping
+        self._synced_dispatch = pending.dispatch
+        self._cur, self._pos, self._active = cur, pos, active
+        self._remaining, self._stepno = remaining, stepno
+        if self._carry is pending.carry:
+            # frontier caught up with the newest enqueue — barrier
+            # dispatches may run again
+            self._carry = None
+        if pending.kind == "spec":
+            return self._sync_spec(pending, emitted, accepted, rejected,
+                                   finished, overlap_ms)
 
         done: List[Completion] = []
         for slot in range(self.num_slots):
@@ -1246,11 +1466,19 @@ class ServeEngine:
                 hit_eos = req.eos_id is not None and toks[-1] == req.eos_id
                 done.append(self._retire(
                     slot, FINISH_EOS if hit_eos else FINISH_LENGTH))
-        self.steps += 1
-        self.decode_substeps += self.steps_per_dispatch
         if tel is not None:
-            tel.event("engine.step", dispatch=self.steps,
-                      active=self.active_count, retired=len(done))
+            if pending.asynchronous:
+                tel.event("engine.dispatch_synced",
+                          dispatch=pending.dispatch, kind="step",
+                          retired=len(done))
+                tel.metrics.histogram(
+                    "serve_dispatch_overlap_ms",
+                    help="host work overlapped with an in-flight "
+                    "dispatch: enqueue return -> sync start, wall ms"
+                ).observe(overlap_ms)
+            else:
+                tel.event("engine.step", dispatch=pending.dispatch,
+                          active=self.active_count, retired=len(done))
         return done
 
     def _write_masked_table(self) -> np.ndarray:
@@ -1261,14 +1489,17 @@ class ServeEngine:
         return np.where(self._active[:, None], self.pool.page_table,
                         -1).astype(np.int32)
 
-    def _spec_step(self) -> List[Completion]:
-        """One speculative dispatch: refill stale draft rows, then run
+    def _spec_enqueue(self, asynchronous: bool) -> PendingDispatch:
+        """Enqueue one speculative dispatch: refill stale draft rows
+        (host-side, reading the SYNCED token streams — the refill
+        ledger is why the async client drains its pipeline before any
+        dispatch that marks a slot stale), then launch
         ``steps_per_dispatch`` spec rounds (k+1 draft feeds + one
         ``(B, k+1)`` verify each) in one fused program. Greedy commits
         are token-identical to the plain step path by the accept rule
-        (see serve/spec.py); the host-side retire loop is shared
-        shape-for-shape with :meth:`step` at (rounds, k+1)-token
-        granularity."""
+        (see serve/spec.py); the host-side retire loop
+        (:meth:`_sync_spec`) is shared shape-for-shape with
+        :meth:`step_sync` at (rounds, k+1)-token granularity."""
         faults.fire("serve.dispatch")
         spec = self.spec
         active_req = self.pool.active
@@ -1283,6 +1514,7 @@ class ServeEngine:
         faults.fire("serve.verify")
         tel = self._tel
         k, rounds = spec.k, self.steps_per_dispatch
+        cur, pos, act, remaining, stepno = self._carry_in()
         with (tel.span("engine.spec_round", active=int(self._active.sum()),
                        k=k) if tel is not None else NULL_SPAN):
             if self.paged and self.page_native:
@@ -1291,40 +1523,57 @@ class ServeEngine:
                 # one engine (the draft cache stays dense either way)
                 fn = _pick(_spec_page_native_donated,
                            _spec_page_native_plain)
-                (self.pool.arena, spec.cache, cur, pos, active, remaining,
+                (self.pool.arena, spec.cache, cur, pos, act, remaining,
                  stepno, emitted, accepted, rejected, finished) = fn(
                     self.model, spec.model, self.params, spec.params,
                     self.pool.arena, self._write_masked_table(),
-                    spec.cache, self._cur, self._pos, self._active,
-                    self._remaining, self._temp, self._top_k, self._eos,
-                    self._keys, self._stepno, k=k, rounds=rounds)
+                    spec.cache, cur, pos, act,
+                    remaining, self._temp, self._top_k, self._eos,
+                    self._keys, stepno, k=k, rounds=rounds)
             elif self.paged:
                 fn = _pick(_spec_paged_donated, _spec_paged_plain)
-                (self.pool.arena, spec.cache, cur, pos, active, remaining,
+                (self.pool.arena, spec.cache, cur, pos, act, remaining,
                  stepno, emitted, accepted, rejected, finished) = fn(
                     self.model, spec.model, self.params, spec.params,
                     self.pool.arena, np.array(self.pool.page_table),
-                    spec.cache, self._cur, self._pos, self._active,
-                    self._remaining, self._temp, self._top_k, self._eos,
-                    self._keys, self._stepno, k=k, rounds=rounds)
+                    spec.cache, cur, pos, act,
+                    remaining, self._temp, self._top_k, self._eos,
+                    self._keys, stepno, k=k, rounds=rounds)
             else:
                 fn = _pick(_spec_rounds_donated, _spec_rounds_plain)
-                (self.pool.cache, spec.cache, cur, pos, active, remaining,
+                (self.pool.cache, spec.cache, cur, pos, act, remaining,
                  stepno, emitted, accepted, rejected, finished) = fn(
                     self.model, spec.model, self.params, spec.params,
-                    self.pool.cache, spec.cache, self._cur, self._pos,
-                    self._active, self._remaining, self._temp,
-                    self._top_k, self._eos, self._keys, self._stepno,
+                    self.pool.cache, spec.cache, cur, pos,
+                    act, remaining, self._temp,
+                    self._top_k, self._eos, self._keys, stepno,
                     k=k, rounds=rounds)
-        self._cur = np.array(cur)
-        self._pos = np.array(pos)
-        self._active = np.array(active)
-        self._remaining = np.array(remaining)
-        self._stepno = np.array(stepno)
-        emitted = np.asarray(emitted)     # (rounds, B, k+1), −1 = none
-        accepted = np.asarray(accepted)   # (rounds, B) draft credits
-        rejected = np.asarray(rejected)   # (rounds, B) real divergences
-        finished = np.asarray(finished)   # (rounds, B)
+        self._carry = (cur, pos, act, remaining, stepno)
+        self.steps += 1
+        # one verify = one target param read, however many tokens it
+        # committed — the honesty-floor unit stays "target passes"
+        self.decode_substeps += rounds
+        self.spec_rounds += rounds
+        self.spec_draft_steps += (k + 1) * rounds
+        if tel is not None and asynchronous:
+            tel.event("engine.dispatch_enqueued", dispatch=self.steps,
+                      kind="spec")
+        return PendingDispatch(
+            kind="spec", dispatch=self.steps, rounds=rounds,
+            emitted=emitted, finished=finished, carry=self._carry,
+            owner=self._engine_token,
+            accepted=accepted, rejected=rejected,
+            asynchronous=asynchronous, enqueued_at=time.perf_counter())
+
+    def _sync_spec(self, pending: PendingDispatch, emitted, accepted,
+                   rejected, finished,
+                   overlap_ms: float) -> List[Completion]:
+        """The spec half of :meth:`step_sync`: (rounds, B, k+1) retire
+        loop + acceptance accounting. The arrays arrive already
+        materialized — every fallible host copy happens before the
+        caller's commit point."""
+        tel = self._tel
+        rounds = pending.rounds
 
         done: List[Completion] = []
         committed = 0
@@ -1349,19 +1598,24 @@ class ServeEngine:
         # the draft's true quality — 1.0 for a perfectly-agreeing draft
         # even on its final, budget-clamped round
         judged = acc_total + rej_total
-        self.steps += 1
-        # one verify = one target param read, however many tokens it
-        # committed — the honesty-floor unit stays "target passes"
-        self.decode_substeps += rounds
-        self.spec_rounds += rounds
-        self.spec_draft_steps += (k + 1) * rounds
         self.spec_accepted_tokens += acc_total
         self.spec_rejected_tokens += rej_total
         if tel is not None:
-            tel.event("engine.spec_round", dispatch=self.steps,
-                      rounds=rounds, judged=judged,
-                      accepted=acc_total, committed=committed,
-                      retired=len(done))
+            if pending.asynchronous:
+                tel.event("engine.dispatch_synced",
+                          dispatch=pending.dispatch, kind="spec",
+                          judged=judged, accepted=acc_total,
+                          committed=committed, retired=len(done))
+                tel.metrics.histogram(
+                    "serve_dispatch_overlap_ms",
+                    help="host work overlapped with an in-flight "
+                    "dispatch: enqueue return -> sync start, wall ms"
+                ).observe(overlap_ms)
+            else:
+                tel.event("engine.spec_round", dispatch=pending.dispatch,
+                          rounds=rounds, judged=judged,
+                          accepted=acc_total, committed=committed,
+                          retired=len(done))
             m = tel.metrics
             m.counter("serve_spec_accepted_tokens_total",
                       help="draft tokens accepted by the verify step"
@@ -1400,6 +1654,7 @@ class ServeEngine:
         slot = self.pool.slot_of(request_id)
         if slot is None:
             return None
+        self._require_synced("cancel")
         return self._retire(slot, reason)
 
     def shutdown(self) -> None:
@@ -1415,6 +1670,11 @@ class ServeEngine:
         self.spec = None
         self._chunk_queue.clear()
         self._tokens.clear()
+        # an in-flight enqueued dispatch is DISCARDED with the carry —
+        # the sync-frontier contract: its tokens were never committed,
+        # and (failover) a replay regenerates them elsewhere
+        self._carry = None
+        self._retry_sync = None
         self._active[:] = False
 
     def _retire(self, slot: int, reason: str) -> Completion:

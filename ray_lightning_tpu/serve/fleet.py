@@ -76,7 +76,7 @@ from ray_lightning_tpu.reliability.faults import (InjectedFault, MODE_STALL,
 from ray_lightning_tpu.serve.client import ServeClient
 from ray_lightning_tpu.serve.request import (Completion, FINISH_REJECTED,
                                              OccupancyError, Request)
-from ray_lightning_tpu.serve.scheduler import QueueFull
+from ray_lightning_tpu.serve.scheduler import ACTION_IDLE, QueueFull
 
 __all__ = ["ReplicaFleet", "Router", "RouterConfig", "FleetConfig",
            "FleetSaturated"]
@@ -592,11 +592,23 @@ class ReplicaFleet:
     def tick(self) -> List[Completion]:
         """One fleet scheduling round: every live replica gets one
         dispatch turn (firing the ``serve.replica`` fault site with its
-        id, in list order), then the watchdog applies its silence
-        verdicts and the autoscaler runs. Returns the completions this
-        round retired (failover casualties included)."""
+        id — runnable replicas first, idle ones after, stable
+        replica-id tiebreak within each group, so pinned fault ticks
+        must be aimed with that order in mind), then the watchdog
+        applies its silence verdicts and the autoscaler runs. Returns
+        the completions this round retired (failover casualties
+        included)."""
         done: List[Completion] = []
-        for rep in list(self._replicas):
+        # drive order: replicas with a runnable action (a dispatch to
+        # enqueue, or an async dispatch to reconcile) go FIRST, idle
+        # replicas after — strict list order used to park queued work
+        # on replica 2 behind replica 0's idle turn, and under async
+        # dispatch the early enqueues now compute while the later
+        # replicas' host work runs. Deterministic: stable (runnable,
+        # replica-id) sort, pinned by tests/test_async_dispatch.py.
+        order = sorted(self._replicas,
+                       key=lambda rep: (not self._runnable(rep), rep.id))
+        for rep in order:
             if rep not in self._replicas:
                 continue  # removed by an earlier failover this round
             done.extend(self._tick_replica(rep))
@@ -632,6 +644,19 @@ class ReplicaFleet:
                 help="requests waiting across every replica's queue"
             ).set(sum(len(r.client.scheduler) for r in self._replicas))
         return done
+
+    def _runnable(self, rep: _Replica) -> bool:
+        """Will this replica's tick actually dispatch (or reconcile)
+        something? Reads the scheduler's non-mutating lookahead against
+        the replica's synced engine state — a wedged replica is not
+        runnable (its turn is skipped anyway), an idle one only
+        advances its clock."""
+        if rep.stalled:
+            return False
+        client = rep.client
+        if client.dispatch_in_flight:
+            return True
+        return client.scheduler.peek_action(client.engine) != ACTION_IDLE
 
     def _tick_replica(self, rep: _Replica) -> List[Completion]:
         if rep.stalled:

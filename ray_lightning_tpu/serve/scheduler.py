@@ -149,6 +149,40 @@ class FifoScheduler:
                 r for r in self._queue if id(r) not in gone)
         return expired
 
+    def _admit_width(self, engine) -> int:
+        """How many queue-head requests :meth:`next_action` would pop
+        for a prefill RIGHT NOW — 0 when the next dispatch is not a
+        prefill. Pure query (no pops, no latch flips): the decision
+        half of ``next_action``, shared with :meth:`peek_action` so the
+        lookahead can never drift from the real policy."""
+        free = engine.free_slots
+        chunks = getattr(engine, "chunk_pending", 0)
+        if not self._queue or free <= 0:
+            return 0
+        k = min(len(self._queue), free)
+        probe = getattr(engine, "admissible_prefix", None)
+        if probe is not None:
+            # page-aware admission: only the head prefix that fits
+            # slots, pages AND the batched-program width (the probe
+            # owns the width rule — chunk-routed requests consume
+            # none of it, so pre-capping at prefill_batch here would
+            # needlessly throttle them). The probe's verdict over a
+            # FIFO prefix is prefix-stable, so feed it the head
+            # slice, not a copy of the whole queue.
+            k = min(k, probe([self._queue[i] for i in range(k)]))
+        else:
+            k = min(k, engine.prefill_batch)
+        if k <= 0:
+            return 0
+        if engine.active_count == 0 and not chunks:
+            return k
+        # batching threshold: how many waiters justify stalling
+        # the in-flight decodes for one prefill dispatch
+        need = max(1, math.ceil(
+            (1.0 - self.config.prefill_priority)
+            * min(engine.prefill_batch, free)))
+        return k if len(self._queue) >= need else 0
+
     def next_action(self, engine) -> Tuple[str, List[Request]]:
         """Decide the next engine dispatch.
 
@@ -158,33 +192,40 @@ class FifoScheduler:
         ``(ACTION_IDLE, [])`` when there is nothing to do (the client
         waits for the next arrival).
         """
-        free = engine.free_slots
-        chunks = getattr(engine, "chunk_pending", 0)
-        if self._queue and free > 0:
-            k = min(len(self._queue), free)
-            probe = getattr(engine, "admissible_prefix", None)
-            if probe is not None:
-                # page-aware admission: only the head prefix that fits
-                # slots, pages AND the batched-program width (the probe
-                # owns the width rule — chunk-routed requests consume
-                # none of it, so pre-capping at prefill_batch here would
-                # needlessly throttle them). The probe's verdict over a
-                # FIFO prefix is prefix-stable, so feed it the head
-                # slice, not a copy of the whole queue.
-                k = min(k, probe([self._queue[i] for i in range(k)]))
-            else:
-                k = min(k, engine.prefill_batch)
-            if k > 0:
-                if engine.active_count == 0 and not chunks:
-                    return ACTION_PREFILL, self._pop(k)
-                # batching threshold: how many waiters justify stalling
-                # the in-flight decodes for one prefill dispatch
-                need = max(1, math.ceil(
-                    (1.0 - self.config.prefill_priority)
-                    * min(engine.prefill_batch, free)))
-                if len(self._queue) >= need:
-                    return ACTION_PREFILL, self._pop(k)
+        k = self._admit_width(engine)
+        if k > 0:
+            return ACTION_PREFILL, self._pop(k)
         return self.drain_action(engine), []
+
+    def peek_action(self, engine) -> str:
+        """What :meth:`next_action` would return, WITHOUT popping
+        requests or flipping the chunk/decode alternation latch.
+
+        The fleet's runnable-replica probe reads this (the async client
+        itself pipelines off ``next_action`` returning ``ACTION_STEP``
+        — this lookahead shares ``_admit_width`` with it, so the two
+        can't drift). The verdict is computed against the engine's
+        SYNCED host state, so with a dispatch in flight it answers for
+        the synced frontier — exactly the state the next *enqueue*
+        would be built from."""
+        if self._admit_width(engine) > 0:
+            return ACTION_PREFILL
+        return self._drain_verdict(engine, self._last_was_chunk)[0]
+
+    @staticmethod
+    def _drain_verdict(engine, latch: bool) -> Tuple[str, bool]:
+        """The chunk/decode half of the policy as a PURE function of
+        the alternation latch: ``(action, new_latch)``.
+        :meth:`drain_action` commits the latch, :meth:`peek_action`
+        discards it — one copy of the policy, so the lookahead cannot
+        drift from what the tick actually dispatches."""
+        if getattr(engine, "chunk_pending", 0):
+            if engine.active_count > 0 and latch:
+                return ACTION_STEP, False
+            return ACTION_CHUNK, True
+        if engine.active_count > 0:
+            return ACTION_STEP, False
+        return ACTION_IDLE, False
 
     def drain_action(self, engine) -> str:
         """The chunk/decode half of the policy: strict alternation while
@@ -193,16 +234,9 @@ class FifoScheduler:
         an admission tick dispatched nothing (every popped request
         seed-deferred) — the substitute dispatch must honor the same
         bound, or a persistent deferral would let chunks starve decode."""
-        if getattr(engine, "chunk_pending", 0):
-            if engine.active_count > 0 and self._last_was_chunk:
-                self._last_was_chunk = False
-                return ACTION_STEP
-            self._last_was_chunk = True
-            return ACTION_CHUNK
-        self._last_was_chunk = False
-        if engine.active_count > 0:
-            return ACTION_STEP
-        return ACTION_IDLE
+        action, self._last_was_chunk = self._drain_verdict(
+            engine, self._last_was_chunk)
+        return action
 
     def _pop(self, k: int) -> List[Request]:
         return [self._queue.popleft() for _ in range(k)]

@@ -89,6 +89,11 @@ def pytest_configure(config):
         "param-byte accounting, page-table-direct KV) — "
         "`pytest -m quant` runs it as a fast targeted subset")
     config.addinivalue_line(
+        "markers", "async_dispatch: depth-2 pipelined serve dispatch "
+        "(ServeClient(async_dispatch=True): enqueue N+1 before syncing "
+        "N, sync-frontier replay contract) — `pytest -m async_dispatch` "
+        "runs it as a fast targeted subset")
+    config.addinivalue_line(
         "markers", "pallas: the hand-tiled pallas paged-attention "
         "kernel (attention_kernel='pallas': fused page gather + "
         "in-kernel int8 dequant + tiled softmax, interpret mode on "

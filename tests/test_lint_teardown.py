@@ -50,6 +50,14 @@ RESOURCE_FACTORIES = {
     # (or executables) would pin device programs past its engine, so
     # the factory names are covered up front
     "paged_attention", "PagedAttentionKernel",
+    # async dispatch: a deferred-sync handle pins the enqueued
+    # dispatch's device outputs (emitted/finished/carry futures) — a
+    # container holding one past its engine's life would keep those
+    # buffers (and with them the donated KV chain) alive, so any
+    # `self.X = <engine>.step_enqueue()` / `self.X = PendingDispatch(…)`
+    # seat must be released (`ServeClient.shutdown()` discards the
+    # outstanding handle before the engine drops its pool)
+    "step_enqueue", "PendingDispatch",
 }
 
 RELEASE_METHODS = {"shutdown", "close", "_kill", "kill"}
