@@ -70,8 +70,28 @@ def main():
                              "rounding per weight, so greedy rows are "
                              "no longer verified against generate()'s "
                              "full-precision reference).")
+    parser.add_argument("--weight-group-size", type=int, default=None,
+                        help="int4 group length along each leaf's last "
+                             "axis (default 64 — must divide every "
+                             "feature dim; this nano model's head_dim "
+                             "is 32, so pass 32 or 16 with "
+                             "--weight-dtype int4).")
+    parser.add_argument("--matmul-kernel", default=None,
+                        choices=["xla", "pallas"],
+                        help="how quantized weights reach the matmuls "
+                             "(needs --weight-dtype): 'xla' "
+                             "materializes a dequantized tree once per "
+                             "dispatch (default), 'pallas' streams the "
+                             "codes + scales straight into a fused "
+                             "dequant-matmul kernel — no dense weight "
+                             "arena, the per-dispatch param stream is "
+                             "the codes+scales floor (interpret mode "
+                             "off-TPU; tokens identical either way).")
     parser.add_argument("--max-epochs", type=int, default=1)
     args = parser.parse_args()
+    if args.matmul_kernel == "pallas" and args.weight_dtype is None:
+        parser.error("--matmul-kernel pallas needs --weight-dtype "
+                     "(the fused kernel consumes quantized codes)")
 
     from ray_lightning_tpu import RayStrategy, Trainer
     from ray_lightning_tpu.models import GPTModule, TransformerLM, gpt2_config
@@ -121,7 +141,9 @@ def main():
         prefill_len=args.prefill_len,
         steps_per_dispatch=args.steps_per_dispatch,
         async_dispatch=args.async_dispatch,
-        weight_dtype=args.weight_dtype, **paged_kw,
+        weight_dtype=args.weight_dtype,
+        weight_group_size=args.weight_group_size,
+        matmul_kernel=args.matmul_kernel, **paged_kw,
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))
     t0 = time.perf_counter()

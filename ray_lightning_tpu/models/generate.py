@@ -72,7 +72,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ray_lightning_tpu.models.quant import dequantize_params
+from ray_lightning_tpu.models.quant import materialize_for_program
 from ray_lightning_tpu.models.transformer import latch_eos
 
 
@@ -195,11 +195,13 @@ def decode_step(model, params, cache, tokens: jax.Array,
     homogeneous batch vs per-request keys and sampling params).
 
     ``params`` may be weight-quantized (:mod:`..models.quant`): the
-    entry guard dequantizes — a trace-time no-op on plain trees. The
-    serve programs dequantize once at THEIR entry (outside the step
-    scans), so this guard only fires for direct callers.
+    shared entry guard (``materialize_for_program`` — a trace-time
+    no-op on plain trees) dequantizes under ``matmul_kernel="xla"``
+    and passes the codes through to the fused kernel under
+    ``"pallas"``. The serve programs guard once at THEIR entry
+    (outside the step scans), so this only fires for direct callers.
     """
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, tokens,
         positions=kv_positions, kv_positions=kv_positions,
@@ -243,7 +245,7 @@ def decode_step_paged(model, params, arena, tokens: jax.Array,
     engine passes its write-masked table, so retired/chunking rows'
     parked writes drop. Returns ``(last_logits (B, V), arena)``.
     """
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     logits, arena = _arena_apply(model, params, arena, tokens,
                                  kv_positions, page_table)
     return logits[:, -1], arena
@@ -255,7 +257,7 @@ def verify_step_paged(model, params, arena, tokens: jax.Array,
     verify's per-row (B, T) block scoring, reading/writing K/V through
     the page table. Returns ``(logits (B, T, V), arena)`` — every
     offset's logits, as the accept rule requires."""
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     return _arena_apply(model, params, arena, tokens, kv_positions,
                         page_table)
 
@@ -282,7 +284,7 @@ def verify_step(model, params, cache, tokens: jax.Array,
     land at or before those positions before any mask re-admits them
     (same argument as the chunk-prefill path).
     """
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, tokens,
         positions=kv_positions, kv_positions=kv_positions,
@@ -291,7 +293,7 @@ def verify_step(model, params, cache, tokens: jax.Array,
 
 
 def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     B, P = prompt_tokens.shape
     prompt_tokens = prompt_tokens.astype(jnp.int32)
     cache = model.init(jax.random.PRNGKey(0),

@@ -1,0 +1,276 @@
+"""Pallas fused dequant-matmul: stream int8/int4 weight CODES into the
+matmul kernel and kill the per-dispatch dequant pass.
+
+PR 11's weight-only quantization cut at-rest param bytes to
+0.25x/0.14x, but ``dequantize_params`` still materialized a
+full-precision parameter tree at every program entry — so the
+per-dispatch HBM byte stream, the thing decode is bound by, never
+shrank (quantized decode honestly LOSES wall-clock on hosts without
+convert-into-GEMM fusion; ``docs/performance.md`` round 11). This
+kernel is the weight-side sibling of ``models/pallas_attention.py``
+(which closed the same gap for KV codes): the projection matmuls
+consume the quantized codes DIRECTLY —
+
+- **codes+scales in, no dense weight anywhere** — the weight operand
+  of each grid step is a ``(tile_k, tile_n)`` block of int8 codes (int4:
+  nibble-packed ``(tile_k, tile_n/2)``) plus its scale block, streamed
+  HBM→VMEM by the BlockSpec pipeline. Unpacking and the ``codes x
+  scales`` multiply happen on the VMEM block right before the dot; the
+  only full-precision weight in existence is one tile of VMEM scratch
+  per grid step. The per-dispatch param byte stream drops to the
+  codes+scales floor ``models/quant.py param_bytes`` already accounts.
+- **in-kernel int4 nibble unpack** — arithmetic-shift sign extension on
+  int32 views (:func:`unpack_int4_block`, pinned value-for-value
+  against ``quant.unpack_int4`` over all 16 codes), low nibble first,
+  exactly the ``pack_int4`` layout.
+- **per-output-channel / per-group scales on the block** — int8 scales
+  broadcast along the tile's contraction rows; int4 group scales apply
+  on the ``(rows, tile/group_size, group_size)`` grouped view. Scales
+  are never folded into the activations: the dequantized block is the
+  same element-wise ``codes x scale`` product the XLA path computes,
+  which is what makes the identity contract below possible.
+- **both weight orientations** — ``transpose=False`` contracts the
+  stored leaf's axis 0 (every Dense/DenseGeneral kernel: qkv, out,
+  mlp up/down, the untied lm_head); ``transpose=True`` contracts the
+  stored last axis (the tied LM head, ``wte.attend``'s ``x @ E.T`` —
+  the same codes the embedding LOOKUP gathers row-wise).
+
+Identity contract (the ``models/pallas_attention.py`` precedent): at
+the default tiling — full K per grid step, output tiled over (M, N) —
+the kernel's dot has the dequantize-then-XLA-matmul path's exact
+per-element reduction, and under **interpret mode** on the CPU tier it
+is bitwise that path (pinned by ``tests/test_pallas_matmul.py``; the
+engine suites ENFORCE greedy token identity at 0 mismatches on top).
+``tile_k < K`` splits the contraction into f32-accumulated partial
+dots — the TPU occupancy lever, where Mosaic tile scheduling reorders
+reductions anyway and the documented fallback is the PR 11
+teacher-forced-agreement contract (``docs/serving.md``).
+
+Engines select this path with ``ServeEngine/ServeClient(...,
+matmul_kernel="pallas")`` (requires ``weight_dtype=``; the cfg field
+``TransformerConfig.matmul_kernel`` is the source of truth the layers
+dispatch on, so supervisor rebuilds and fleet replicas re-select
+identical programs). ``quant.materialize_for_program`` then skips the
+program-entry dequant and the codes flow through jit as pytree leaves.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ray_lightning_tpu.models.pallas_attention import interpret_default
+from ray_lightning_tpu.models.quant import QTensor, matmul_view
+
+__all__ = ["quantized_matmul", "unpack_int4_block", "kernel_calls"]
+
+#: default caps for the derived output tile (largest divisor of the
+#: axis at or under the cap) — one (tile_m, tile_n) f32 out block plus
+#: the K-long code and x panels stay far under the ~16 MB VMEM budget.
+#: tile_m needs the cap too: M is the FLATTENED token count, and a
+#: prefill/verify dispatch's (M, K) x panel would otherwise ride into
+#: one grid step whole (decode steps sit far below it either way).
+#: Output tiling never touches an element's reduction order, so the
+#: caps are invisible to the bitwise identity contract.
+DEFAULT_TILE_N = 512
+DEFAULT_TILE_M = 256
+
+#: trace-time counter of kernel instantiations — the bench's witness
+#: that a "fused" leg actually armed the kernel (a cached program does
+#: not retrace, so snapshot it before the first compile of the leg)
+_KERNEL_CALLS = 0
+
+
+def kernel_calls() -> int:
+    """How many times :func:`quantized_matmul` has traced a kernel this
+    process (compile-time count, not per-dispatch)."""
+    return _KERNEL_CALLS
+
+
+def unpack_int4_block(packed: jax.Array) -> jax.Array:
+    """In-kernel sibling of ``quant.unpack_int4``: sign-extend both
+    nibbles of each byte and re-interleave to the doubled last axis —
+    value-for-value identical (pinned over all 16 codes), but shifted
+    in int32 (int8 shifts are a Mosaic lowering gap; interpret mode
+    computes the same values either way)."""
+    p = packed.astype(jnp.int32)
+    lo = jnp.right_shift(jnp.left_shift(p, 28), 28)  # arithmetic
+    hi = jnp.right_shift(p, 4)   # p is sign-extended: == int8 >> 4
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(*packed.shape[:-1], 2 * packed.shape[-1])
+
+
+def _dequant_block(q_blk, s_blk, *, bits: int, group_size: Optional[int],
+                   param_dtype, compute_dtype):
+    """codes x scales -> one weight tile in compute dtype, the exact
+    element-wise product chain of ``QTensor.dequantize`` followed by
+    flax's promote-to-compute-dtype (so a full-K dot over this block is
+    bitwise the dequantize-then-XLA path)."""
+    if bits == 8:
+        w = q_blk.astype(jnp.float32) * s_blk          # s (1, cols)
+    else:
+        codes = unpack_int4_block(q_blk).astype(jnp.float32)
+        rows = codes.shape[0]
+        grouped = codes.reshape(rows, -1, group_size)
+        w = (grouped * s_blk[:, :, None]).reshape(codes.shape)
+    return w.astype(param_dtype).astype(compute_dtype)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, *acc, bits, group_size,
+            dims, nk, param_dtype, compute_dtype):
+    """One (m, n, k) grid step. ``nk == 1`` (the default and the
+    identity contract): ONE dot over the full contraction, no
+    ``preferred_element_type`` override — the exact dot the XLA path
+    runs on the promoted operands, and no scratch exists. ``nk > 1``:
+    f32-accumulated partial dots in VMEM scratch (TPU tiling mode; fp
+    reordering documented)."""
+    w = _dequant_block(q_ref[...], s_ref[...], bits=bits,
+                       group_size=group_size, param_dtype=param_dtype,
+                       compute_dtype=compute_dtype)
+    if nk == 1:
+        o_ref[...] = jax.lax.dot_general(x_ref[...], w, dims)
+        return
+    acc_ref = acc[0]
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w, dims, preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _emit():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _largest_divisor(n: int, cap: int, align: int) -> int:
+    """Largest divisor of ``n`` that is <= cap and a multiple of
+    ``align`` (falls back to ``n`` itself — ``align`` always divides
+    ``n`` for the layouts quantize_params produces)."""
+    for d in range(min(cap, n), 0, -1):
+        if n % d == 0 and d % align == 0:
+            return d
+    return n
+
+
+def quantized_matmul(x: jax.Array, qt: QTensor, *,
+                     transpose: bool = False,
+                     tile_m: Optional[int] = None,
+                     tile_n: Optional[int] = None,
+                     tile_k: Optional[int] = None,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """``x (..., K) @ dequantize(qt) -> (..., N)`` with the dequant
+    fused into the matmul kernel — no dense weight materializes.
+
+    ``transpose=False`` contracts ``qt``'s stored axis 0 and flattens
+    the remaining axes to ``N`` (the caller reshapes to its feature
+    dims); ``transpose=True`` contracts the stored LAST axis (the tied
+    LM head's ``x @ E.T``). Output dtype is ``x.dtype`` — callers
+    promote to compute dtype first, exactly like flax's Dense.
+
+    Tiling: ``tile_k`` defaults to the full contraction (the bitwise
+    mode); ``tile_m``/``tile_n`` default to the largest divisor of
+    their axis at or under :data:`DEFAULT_TILE_M` /
+    :data:`DEFAULT_TILE_N` (group-aligned for int4 — output tiling is
+    invisible to the identity contract). Every tile must
+    divide its axis exactly — a ragged final tile raises (the compiled
+    fixed-shape serve programs must never mask a partial block
+    silently) — and int4 group boundaries must not split across tiles:
+    ``group_size`` must divide ``tile_n`` (dense orientation) or
+    ``tile_k`` (transpose orientation, where the groups ride the
+    contraction axis).
+    """
+    codes, scales, K, N = matmul_view(qt, transpose)
+    if x.shape[-1] != K:
+        raise ValueError(
+            f"quantized_matmul contraction mismatch: x has "
+            f"{x.shape[-1]} features, the quantized leaf contracts "
+            f"over {K}")
+    lead = x.shape[:-1]
+    x2d = x.reshape(-1, K)
+    M = x2d.shape[0]
+    gs = qt.group_size if qt.bits == 4 else 1
+    if tile_m is None:
+        tile_m = _largest_divisor(M, DEFAULT_TILE_M, 1)
+    tile_k = K if tile_k is None else tile_k
+    if tile_n is None:
+        tile_n = _largest_divisor(
+            N, DEFAULT_TILE_N, gs if not transpose else 1)
+        # divisor-poor N (an unpadded 50257-class vocab on the LM
+        # head: 50257 = 29 x 1733, no divisor in (29, 512]) would
+        # otherwise degrade to sliver tiles — thousands of grid steps
+        # of lane-misaligned blocks Mosaic can't lower. Fall back to
+        # ONE full-width tile: bitwise-identical (output tiling never
+        # touches a reduction), fine under interpret mode; on a real
+        # TPU pad the vocab to a friendly multiple instead (standard
+        # practice) or pass tile_n explicitly.
+        if tile_n < min(N, 128):
+            tile_n = N
+    for name, tile, dim in (("tile_m", tile_m, M), ("tile_n", tile_n, N),
+                            ("tile_k", tile_k, K)):
+        if tile < 1 or dim % tile:
+            raise ValueError(
+                f"{name}={tile} does not divide its axis ({dim}): the "
+                "kernel's fixed-shape grid would leave a ragged final "
+                "tile — pick a tile that divides the axis exactly")
+    if qt.bits == 4:
+        group_axis, tile_g = (("tile_k", tile_k) if transpose
+                              else ("tile_n", tile_n))
+        if tile_g % qt.group_size:
+            raise ValueError(
+                f"group_size ({qt.group_size}) must divide {group_axis} "
+                f"({tile_g}): int4 scale groups ride the "
+                f"{'contraction' if transpose else 'output'} axis and "
+                "a tile boundary must not split a group")
+    if interpret is None:
+        interpret = interpret_default()
+
+    nm, nn, nk = M // tile_m, N // tile_n, K // tile_k
+    pack = 2 if qt.bits == 4 else 1
+
+    if transpose:
+        # codes (N, K/pack): rows = output tile, cols = contraction
+        q_spec = pl.BlockSpec((tile_n, tile_k // pack),
+                              lambda i, j, kk: (j, kk))
+        if qt.bits == 8:
+            s_spec = pl.BlockSpec((1, tile_k), lambda i, j, kk: (0, kk))
+        else:
+            s_spec = pl.BlockSpec((tile_n, tile_k // gs),
+                                  lambda i, j, kk: (j, kk))
+        dims = (((1,), (1,)), ((), ()))
+    else:
+        # codes (K, N/pack): rows = contraction, cols = output tile
+        q_spec = pl.BlockSpec((tile_k, tile_n // pack),
+                              lambda i, j, kk: (kk, j))
+        if qt.bits == 8:
+            s_spec = pl.BlockSpec((1, tile_n), lambda i, j, kk: (0, j))
+        else:
+            s_spec = pl.BlockSpec((tile_k, tile_n // gs),
+                                  lambda i, j, kk: (kk, j))
+        dims = (((1,), (0,)), ((), ()))
+
+    kernel = functools.partial(
+        _kernel, bits=qt.bits, group_size=qt.group_size, dims=dims,
+        nk=nk, param_dtype=qt.dtype, compute_dtype=x.dtype)
+    global _KERNEL_CALLS
+    _KERNEL_CALLS += 1
+    out = pl.pallas_call(
+        kernel,
+        grid=(nm, nn, nk),
+        in_specs=[pl.BlockSpec((tile_m, tile_k), lambda i, j, kk: (i, kk)),
+                  q_spec, s_spec],
+        out_specs=pl.BlockSpec((tile_m, tile_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+        # f32 partial-dot accumulator — only the nk > 1 tiling needs it
+        scratch_shapes=(
+            [pltpu.VMEM((tile_m, tile_n), jnp.float32)] if nk > 1
+            else []),
+        interpret=interpret,
+    )(x2d, codes, scales)
+    return out.reshape(*lead, N)

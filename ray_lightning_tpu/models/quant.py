@@ -32,8 +32,12 @@ are the children, so a quantized tree flows through ``jax.jit``
 boundaries, donation and ``tree_map`` exactly like a plain one, and the
 (bits, group_size, shape, dtype) metadata rides in the static aux data
 (hashable: re-quantized trees hit the same compiled programs).
-:func:`dequantize_params` is a no-op on plain trees, which is how every
-serve program guards its entry (see ``models/generate.py``): callers
+:func:`materialize_for_program` is the one program-entry guard every
+serve/generate program calls (see ``models/generate.py``) — a no-op on
+plain trees, a once-per-dispatch :func:`dequantize_params` under
+``matmul_kernel="xla"``, a pass-through of the codes under
+``matmul_kernel="pallas"`` (the fused dequant-matmul kernel,
+``models/pallas_matmul.py``, then consumes them in place): callers
 never need to know whether the params they hold are quantized.
 
 Eligibility: floating-point leaves with ``ndim >= 2`` (matmul kernels
@@ -63,7 +67,7 @@ import numpy as np
 __all__ = ["QTensor", "quantize_params", "dequantize_params",
            "is_quantized", "param_bytes", "check_weight_dtype",
            "pack_int4", "unpack_int4", "kv_scales", "kv_quantize",
-           "kv_dequantize"]
+           "kv_dequantize", "matmul_view", "materialize_for_program"]
 
 #: default int4 group length along the last axis — 64 divides every
 #: features dim in this model family (head_dim, d_model, d_ff, the
@@ -283,6 +287,92 @@ def dequantize_params(params):
     return jax.tree_util.tree_map(
         lambda leaf: leaf.dequantize() if _is_qtensor(leaf) else leaf,
         params, is_leaf=_is_qtensor)
+
+
+def matmul_view(qt: "QTensor", transpose: bool = False):
+    """Kernel-input views of one quantized leaf for the fused
+    dequant-matmul kernel (``models/pallas_matmul.py``): the stored
+    codes and scales reshaped to the 2-D tile-friendly layout the
+    kernel's BlockSpecs slice, WITHOUT materializing any dequantized
+    weight (reshapes of the at-rest arrays, plus — int8 dense
+    orientation only — an ``N``-float tile of the per-channel scale
+    vector, negligible next to the codes).
+
+    Two orientations, matching the two ways this model family consumes
+    a weight leaf:
+
+    - ``transpose=False`` (Dense/DenseGeneral kernels, stored
+      ``(K, *features)``): contraction runs over axis 0, the output
+      axes flatten to ``N``. Codes view ``(K, N)`` int8 (int4:
+      ``(K, N/2)`` packed — nibble pairs flatten contiguously because
+      ``group_size`` divides the stored last axis). Scales: int8
+      per-output-channel expands to a ``(1, N)`` per-column vector
+      (the stored scale repeats per leading output index — exact, no
+      arithmetic); int4 group scales view as ``(K, N/group_size)``
+      where flattened column ``n`` belongs to group ``n //
+      group_size``.
+    - ``transpose=True`` (the tied LM head: ``wte.attend`` contracts
+      ``x @ E.T`` over the EMBEDDING's last axis): codes view
+      ``(N, K)`` (int4: ``(N, K/2)``), int8 scales ``(1, K)`` (they
+      ride the contraction axis — the kernel dequantizes element-wise
+      before the dot, never folds scales into activations, which is
+      what keeps it bitwise the dequantize-then-matmul path), int4
+      scales ``(N, K/group_size)``.
+
+    Returns ``(codes2d, scales2d, K, N)``.
+    """
+    shape = qt.shape
+    if transpose:
+        K = shape[-1]
+        N = int(np.prod(shape[:-1], dtype=np.int64))
+        if qt.bits == 8:
+            return qt.q.reshape(N, K), qt.scale.reshape(1, K), K, N
+        return (qt.q.reshape(N, K // 2),
+                qt.scale.reshape(N, K // qt.group_size), K, N)
+    K = shape[0]
+    N = int(np.prod(shape[1:], dtype=np.int64))
+    if qt.bits == 8:
+        last = shape[-1]
+        scales = jnp.tile(qt.scale.reshape(1, last), (1, N // last))
+        return qt.q.reshape(K, N), scales, K, N
+    return (qt.q.reshape(K, N // 2),
+            qt.scale.reshape(K, N // qt.group_size), K, N)
+
+
+def materialize_for_program(params, cfg=None):
+    """The ONE shared program-entry guard every serve/generate program
+    calls on its params (the single seam the entry points cannot drift
+    from): a trace-time no-op on plain trees; on weight-quantized trees
+    it is **kernel-aware**:
+
+    - ``cfg.matmul_kernel == "xla"`` (or no cfg): materialize the
+      original-dtype weights once per dispatch, outside the step scans
+      (:func:`dequantize_params` — the PR 11 behavior: codes stream
+      from HBM, the dequantized tree is dispatch-scoped scratch).
+    - ``cfg.matmul_kernel == "pallas"``: the codes/scales flow through
+      the jit boundary AS the param leaves (``QTensor`` is a
+      registered pytree) and every consuming layer dispatches the
+      fused dequant-matmul kernel — no dense dequantized weight arena
+      exists anywhere, so the per-dispatch param byte stream is the
+      codes+scales floor :func:`param_bytes` accounts.
+
+    ``cfg`` is the consuming model's ``TransformerConfig`` (callers
+    pass ``model.cfg``); model families without a ``matmul_kernel``
+    field always materialize.
+    """
+    if not is_quantized(params):
+        return params
+    if cfg is not None and getattr(cfg, "matmul_kernel", "xla") == "pallas":
+        if getattr(cfg, "scan_layers", False):
+            raise ValueError(
+                "matmul_kernel='pallas' cannot run quantized weights "
+                "through scanned layers: nn.scan slices every param "
+                "leaf along the layer axis, and a QTensor's broadcast-"
+                "shaped scales have no such axis. Serving wants "
+                "scan_layers=False anyway (docs/performance.md decode "
+                "section) — unstack_scan_params the weights first")
+        return params
+    return dequantize_params(params)
 
 
 def param_bytes(params) -> int:

@@ -70,6 +70,19 @@ class TransformerConfig:
     # under pallas interpret mode off-TPU). Selected via
     # ServeEngine/ServeClient(attention_kernel=...).
     attention_kernel: str = "xla"    # xla | pallas
+    # kernel for weight-QUANTIZED matmuls (params holding QTensor
+    # leaves — models/quant.py; inert on plain trees): "xla" =
+    # dequantize the whole tree once at program entry (the PR 11
+    # materialized-dequant path, quant.materialize_for_program), then
+    # plain XLA matmuls; "pallas" = stream the int8/int4 codes + group
+    # scales INTO a fused dequant-matmul kernel per projection
+    # (models/pallas_matmul.py — nibble unpack and codes x scales on
+    # VMEM tiles, no dense dequantized weight arena anywhere, so the
+    # per-dispatch param byte stream drops to the codes+scales floor).
+    # Selected via ServeEngine/ServeClient(matmul_kernel=...); runs
+    # under pallas interpret mode off-TPU, bitwise the "xla" path at
+    # the default tiling (docs/serving.md for the identity contract).
+    matmul_kernel: str = "xla"       # xla | pallas
     # f32 (default) is the numerically-safe softmax; bf16 halves the
     # (B,H,T,T) score-tensor HBM traffic — +13% measured on the GPT-2
     # bench step (v5e) at ~1% attention-weight rounding. Only the 'dot'
@@ -93,6 +106,10 @@ class TransformerConfig:
             raise ValueError(
                 f"attention_kernel must be 'xla' or 'pallas', got "
                 f"{self.attention_kernel!r}")
+        if self.matmul_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"matmul_kernel must be 'xla' or 'pallas', got "
+                f"{self.matmul_kernel!r}")
         if self.remat_policy is not None:
             if not self.remat:
                 raise ValueError(
@@ -154,6 +171,153 @@ def tensor_parallel_rule(path, leaf):
     return P()
 
 
+# --------------------------------------------------------- quant layers
+# Drop-in projections/embeddings that consume weight-QUANTIZED param
+# leaves (models/quant.py QTensor) in place. The plain-param path
+# DELEGATES to the stock flax module through nn.share_scope — same
+# param names/paths (tensor_parallel_rule and un/stack_scan_params
+# keep matching), same initializers, bitwise-identical apply — so
+# every unquantized model in the family is byte-for-byte unchanged.
+# When the bound leaf is a QTensor (matmul_kernel="pallas" lets
+# quant.materialize_for_program pass codes through the jit boundary):
+#
+# - cfg.matmul_kernel == "pallas": the matmul dispatches the fused
+#   dequant-matmul kernel (models/pallas_matmul.py) — codes + scales
+#   stream straight into the dot, no dense weight materializes.
+# - otherwise (a direct caller handed codes to an "xla" model): the
+#   leaf dequantizes layer-locally — same tokens, dispatch-scoped
+#   dequant scratch — instead of failing flax's param shape check.
+#
+# Embedding LOOKUPS gather codes + scales row-wise and dequantize the
+# gathered rows (element-wise dequant commutes with gather: bitwise
+# the dequantize-then-take path at a fraction of the bytes).
+
+def _raw_qtensor(mod: nn.Module, name: str):
+    """The bound param leaf iff it is a QTensor — read raw (bypassing
+    ``self.param``'s structural check, which would flatten the QTensor
+    into its two children and refuse); None during init and on plain
+    trees (the delegation path)."""
+    from ray_lightning_tpu.models.quant import QTensor
+    if mod.is_initializing() or not mod.has_variable("params", name):
+        return None
+    leaf = mod.get_variable("params", name)
+    return leaf if isinstance(leaf, QTensor) else None
+
+
+def _quant_matmul(x, qt, matmul_kernel: str, transpose: bool = False):
+    """One quantized-leaf contraction in compute dtype: the fused
+    kernel under "pallas", a layer-local dequantize + the identical
+    XLA dot otherwise. Both branches return the FLATTENED ``(..., N)``
+    form — callers reshape to their feature dims."""
+    if matmul_kernel == "pallas":
+        from ray_lightning_tpu.models.pallas_matmul import quantized_matmul
+        return quantized_matmul(x, qt, transpose=transpose)
+    w = qt.dequantize().astype(x.dtype)
+    if transpose:
+        return jnp.dot(x, w.T)
+    return jax.lax.dot_general(
+        x, w.reshape(w.shape[0], -1),
+        (((x.ndim - 1,), (0,)), ((), ())))
+
+
+class QuantDenseGeneral(nn.Module):
+    """``nn.DenseGeneral(axis=-1)`` that also consumes QTensor kernels
+    (module comment above). ``features`` may be an int or a tuple."""
+    features: Any
+    matmul_kernel: str = "xla"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        self._dense = nn.DenseGeneral(
+            features=self.features, axis=-1, use_bias=self.use_bias,
+            dtype=self.dtype, param_dtype=self.param_dtype)
+        nn.share_scope(self, self._dense)
+
+    def __call__(self, x):
+        qt = _raw_qtensor(self, "kernel")
+        if qt is None:
+            return self._dense(x)
+        y = _quant_matmul(x.astype(self.dtype), qt, self.matmul_kernel)
+        feats = (self.features if isinstance(self.features, tuple)
+                 else (self.features,))
+        y = y.reshape(*x.shape[:-1], *feats)
+        if self.use_bias:
+            y = y + jnp.asarray(self.get_variable("params", "bias"),
+                                self.dtype)
+        return y
+
+
+class QuantDense(nn.Module):
+    """``nn.Dense`` that also consumes QTensor kernels."""
+    features: int
+    matmul_kernel: str = "xla"
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        self._dense = nn.Dense(
+            self.features, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype)
+        nn.share_scope(self, self._dense)
+
+    def __call__(self, x):
+        qt = _raw_qtensor(self, "kernel")
+        if qt is None:
+            return self._dense(x)
+        y = _quant_matmul(x.astype(self.dtype), qt, self.matmul_kernel)
+        y = y.reshape(*x.shape[:-1], self.features)
+        if self.use_bias:
+            y = y + jnp.asarray(self.get_variable("params", "bias"),
+                                self.dtype)
+        return y
+
+
+class QuantEmbed(nn.Module):
+    """``nn.Embed`` that also consumes a QTensor embedding table: the
+    lookup gathers codes (+ int4 group scales) row-wise and dequantizes
+    the gathered rows; ``attend`` — the tied LM head — contracts the
+    codes through the fused kernel's transpose orientation (the scales
+    ride the contraction axis there; see ``quant.matmul_view``)."""
+    num_embeddings: int
+    features: int
+    matmul_kernel: str = "xla"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        self._embed = nn.Embed(
+            self.num_embeddings, self.features, dtype=self.dtype,
+            param_dtype=self.param_dtype)
+        nn.share_scope(self, self._embed)
+
+    def __call__(self, ids):
+        qt = _raw_qtensor(self, "embedding")
+        if qt is None:
+            return self._embed(ids)
+        if qt.bits == 8:
+            rows = jnp.take(qt.q, ids, axis=0).astype(jnp.float32)
+            w = rows * qt.scale[0]              # (1, d) scale -> (d,)
+        else:
+            from ray_lightning_tpu.models.quant import unpack_int4
+            packed = jnp.take(qt.q, ids, axis=0)
+            s = jnp.take(qt.scale, ids, axis=0)     # (..., d/gs, 1)
+            codes = unpack_int4(packed).astype(jnp.float32)
+            grouped = codes.reshape(*codes.shape[:-1], -1,
+                                    qt.group_size)
+            w = (grouped * s).reshape(codes.shape)
+        return w.astype(qt.dtype).astype(self.dtype)
+
+    def attend(self, query):
+        qt = _raw_qtensor(self, "embedding")
+        if qt is None:
+            return self._embed.attend(query)
+        return _quant_matmul(query.astype(self.dtype), qt,
+                             self.matmul_kernel, transpose=True)
+
+
 def _attention_fn(cfg: TransformerConfig):
     if cfg.attention_impl == "dot":
         return dot_product_attention
@@ -178,8 +342,9 @@ class MultiHeadAttention(nn.Module):
                  page_table=None):
         cfg = self.cfg
         B, T, _ = x.shape
-        qkv = nn.DenseGeneral(
-            features=(3, cfg.n_heads, cfg.head_dim), axis=-1,
+        qkv = QuantDenseGeneral(
+            features=(3, cfg.n_heads, cfg.head_dim),
+            matmul_kernel=cfg.matmul_kernel,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
         # static index slices, not moveaxis: the 3-to-front transpose
         # materializes a layout-changing copy of the whole qkv tensor on
@@ -195,8 +360,9 @@ class MultiHeadAttention(nn.Module):
             from jax.ad_checkpoint import checkpoint_name
             out = checkpoint_name(out, "attn_out")
             out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
-            return nn.DenseGeneral(
-                features=cfg.d_model, dtype=cfg.dtype,
+            return QuantDenseGeneral(
+                features=cfg.d_model, matmul_kernel=cfg.matmul_kernel,
+                dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype, name="out")(out)
         causal = cfg.causal
         if cfg.decode:
@@ -225,8 +391,9 @@ class MultiHeadAttention(nn.Module):
         from jax.ad_checkpoint import checkpoint_name
         out = checkpoint_name(out, "attn_out")
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
-        return nn.DenseGeneral(
-            features=cfg.d_model, dtype=cfg.dtype,
+        return QuantDenseGeneral(
+            features=cfg.d_model, matmul_kernel=cfg.matmul_kernel,
+            dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="out")(out)
 
     def _decode_cache(self, k, v, kv_positions=None):
@@ -478,14 +645,16 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True):
         cfg = self.cfg
-        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="up")(x)
+        h = QuantDense(cfg.d_ff, matmul_kernel=cfg.matmul_kernel,
+                       dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="up")(x)
         h = nn.gelu(h)
         # named seat for remat policies that save the GELU output
         from jax.ad_checkpoint import checkpoint_name
         h = checkpoint_name(h, "mlp_act")
-        h = nn.Dense(cfg.d_model, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="down")(h)
+        h = QuantDense(cfg.d_model, matmul_kernel=cfg.matmul_kernel,
+                       dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="down")(h)
         if cfg.dropout > 0.0 and not deterministic:
             h = nn.Dropout(cfg.dropout)(h, deterministic=False)
         return h
@@ -739,14 +908,17 @@ class TransformerLM(nn.Module):
         B, T = tokens.shape
         if positions is None:  # decode mode passes cache-index positions
             check_seq_len(cfg, T)
-        wte = nn.Embed(cfg.vocab_size, cfg.d_model,
-                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       name="wte")
+        wte = QuantEmbed(cfg.vocab_size, cfg.d_model,
+                         matmul_kernel=cfg.matmul_kernel,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="wte")
         x = wte(tokens)
         pos = positions if positions is not None else \
             jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-        x = x + nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
-                         param_dtype=cfg.param_dtype, name="wpe")(pos)
+        x = x + QuantEmbed(cfg.max_seq_len, cfg.d_model,
+                           matmul_kernel=cfg.matmul_kernel,
+                           dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name="wpe")(pos)
         x = TransformerStack(cfg, name="stack")(
             x, deterministic=deterministic, kv_positions=kv_positions,
             page_table=page_table)
@@ -756,10 +928,11 @@ class TransformerLM(nn.Module):
         if cfg.tie_embeddings:
             logits = wte.attend(x)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False,
-                              dtype=cfg.dtype,
-                              param_dtype=cfg.param_dtype,
-                              name="lm_head")(x)
+            logits = QuantDense(cfg.vocab_size, use_bias=False,
+                                matmul_kernel=cfg.matmul_kernel,
+                                dtype=cfg.dtype,
+                                param_dtype=cfg.param_dtype,
+                                name="lm_head")(x)
         return logits.astype(jnp.float32)
 
 

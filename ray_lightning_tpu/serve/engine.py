@@ -103,8 +103,8 @@ from ray_lightning_tpu.models.generate import (_logits_only, _prefill_impl,
                                                sample_logits_rows)
 from ray_lightning_tpu.models.quant import (DEFAULT_GROUP_SIZE,
                                             check_weight_dtype,
-                                            dequantize_params, param_bytes,
-                                            quantize_params)
+                                            materialize_for_program,
+                                            param_bytes, quantize_params)
 from ray_lightning_tpu.models.transformer import latch_eos
 from ray_lightning_tpu.obs.spans import NULL_SPAN
 from ray_lightning_tpu.reliability import faults
@@ -236,7 +236,7 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
     # weight-quantized params dequantize ONCE per dispatch, here at the
     # program top (outside the step scan) — storage-only, same contract
     # as the int8 KV storage below
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     storage = cache
     cache = dense_storage_values(model, storage)
 
@@ -384,7 +384,7 @@ def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
     (one program covers every chunk). ``startno`` continues a replayed
     request's key stream, exactly as the batched prefill does.
     """
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
     pt = row_pages[None, :]
     view = _gather_pages(model, arena, pt)
     view = jax.tree_util.tree_map(
@@ -425,7 +425,7 @@ def _page_native_step_impl(model, params, arena, page_table, cur, pos,
     emitted mask). Rows that retire mid-block keep their mapped entries
     and re-write frozen K/V idempotently, exactly like the dense paths.
     """
-    params = dequantize_params(params)
+    params = materialize_for_program(params, model.cfg)
 
     def body(carry, _):
         arena, cur, pos, active, remaining, stepno = carry
@@ -581,6 +581,14 @@ class ServeEngine:
     ``attention_kernel="pallas"`` (requires ``page_native=True``) runs
     the page-native read side as one hand-tiled pallas kernel per
     layer instead of blockwise XLA — same tokens, fewer temporaries.
+    ``matmul_kernel="pallas"`` (requires ``weight_dtype=`` or
+    ``draft_weight_dtype=``, and unrolled layers) streams the
+    quantized weight codes straight into a fused dequant-matmul
+    kernel per projection (``models/pallas_matmul.py``) instead of
+    materializing a dequantized parameter tree once per dispatch —
+    the per-dispatch param byte stream drops to the codes+scales
+    floor ``param_bytes()`` accounts, and tokens stay identical to
+    the materialized path (interpret-mode bitwise on the CPU tier).
 
     Drive it with :class:`~ray_lightning_tpu.serve.client.ServeClient`
     (scheduler + admission control + clocks) or directly:
@@ -602,6 +610,7 @@ class ServeEngine:
                  attention_kernel: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  weight_group_size: Optional[int] = None,
+                 matmul_kernel: Optional[str] = None,
                  draft_model=None, draft_params=None,
                  spec_k: Optional[int] = None,
                  draft_weight_dtype: Optional[str] = None):
@@ -642,6 +651,51 @@ class ServeEngine:
                 "page_size=) too")
         check_weight_dtype(weight_dtype)  # unknown dtypes refused here
         check_weight_dtype(draft_weight_dtype)
+        # matmul_kernel selects the weight-quantized matmul path
+        # (models/pallas_matmul.py), the attention_kernel pattern: None
+        # inherits the model config (default "xla" = materialized
+        # per-dispatch dequant); "pallas" streams the QTensor codes
+        # into a fused dequant-matmul kernel — no dense dequantized
+        # weight arena exists in any program. A config mismatch clones
+        # the model (and the draft model) with the requested kernel, so
+        # supervisor rebuilds and fleet replicas — which re-enter this
+        # ctor with the same kwargs — re-select identical programs.
+        if matmul_kernel not in (None, "xla", "pallas"):
+            raise ValueError(
+                f"matmul_kernel must be None, 'xla' or 'pallas', got "
+                f"{matmul_kernel!r}")
+        if matmul_kernel is not None \
+                and matmul_kernel != cfg.matmul_kernel:
+            model = model.clone(cfg=dataclasses.replace(
+                cfg, matmul_kernel=matmul_kernel))
+            cfg = model.cfg
+        self.matmul_kernel = cfg.matmul_kernel
+        if self.matmul_kernel == "pallas":
+            if weight_dtype is None and draft_weight_dtype is None:
+                raise ValueError(
+                    "matmul_kernel='pallas' is the fused dequant-matmul "
+                    "kernel for QUANTIZED weights (QTensor leaves): "
+                    "pass weight_dtype='int8'|'int4' (or "
+                    "draft_weight_dtype=) too, or drop the kernel — a "
+                    "silently inert knob is a bug magnet")
+            if cfg.scan_layers and weight_dtype is not None:
+                raise ValueError(
+                    "matmul_kernel='pallas' needs scan_layers=False: "
+                    "nn.scan slices every param leaf along the layer "
+                    "axis and QTensor scales have no such axis (serving "
+                    "wants unrolled layers anyway — unstack_scan_params "
+                    "the weights; docs/performance.md decode section)")
+        if draft_model is not None \
+                and draft_model.cfg.matmul_kernel != cfg.matmul_kernel:
+            draft_model = draft_model.clone(cfg=dataclasses.replace(
+                draft_model.cfg, matmul_kernel=cfg.matmul_kernel))
+        if draft_model is not None and draft_weight_dtype is not None \
+                and cfg.matmul_kernel == "pallas" \
+                and draft_model.cfg.scan_layers:
+            raise ValueError(
+                "matmul_kernel='pallas' needs the draft model unrolled "
+                "too (scan_layers=False) when its weights are "
+                "quantized")
         if weight_group_size is not None \
                 and "int4" not in (weight_dtype, draft_weight_dtype):
             raise ValueError(

@@ -395,10 +395,13 @@ class ReplicaFleet:
     the same fixed-shape programs and any replica can seat any
     request; that includes the decode-bandwidth levers
     (``kv_dtype="int8"``, ``weight_dtype="int8"|"int4"``,
-    ``page_native=True``, ``draft_model=``/``spec_k=``) — every
+    ``page_native=True``, ``draft_model=``/``spec_k=``, and the two
+    kernel selectors ``attention_kernel=``/``matmul_kernel=`` — each
+    replica's engine clones the model config with the requested
+    kernels, so the whole fleet re-selects identical programs) — every
     replica re-quantizes the shared raw params to bit-identical codes,
     so failover replay onto a sibling stays token-identical (pinned by
-    ``tests/test_quant.py``). ``submit()`` routes one request;
+    ``tests/test_quant.py`` and ``tests/test_pallas_matmul.py``). ``submit()`` routes one request;
     ``serve_trace()`` / ``run_until_idle()`` mirror the single-client
     surface. Call :meth:`shutdown` when done — it releases every
     replica's KV pool/arena, the standby pool, and the router.

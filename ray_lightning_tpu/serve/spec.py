@@ -71,7 +71,7 @@ from ray_lightning_tpu.models.generate import (_prefill_impl, decode_step,
                                                sample_logits_rows,
                                                verify_step,
                                                verify_step_paged)
-from ray_lightning_tpu.models.quant import dequantize_params
+from ray_lightning_tpu.models.quant import materialize_for_program
 from ray_lightning_tpu.serve.pages import (dense_storage_commit,
                                            dense_storage_values,
                                            fold_rows, gather_pages,
@@ -259,8 +259,8 @@ def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
     ``params``/``draft_params`` may be weight-quantized — dequantized
     here once per dispatch, outside the round scan.
     """
-    params = dequantize_params(params)
-    draft_params = dequantize_params(draft_params)
+    params = materialize_for_program(params, model.cfg)
+    draft_params = materialize_for_program(draft_params, draft_model.cfg)
     storage = cache
     cache = dense_storage_values(model, storage)
     max_pos = model.cfg.max_seq_len - 1
@@ -326,8 +326,8 @@ def _spec_rounds_page_native_impl(model, draft_model, params,
     decrement: rejected drafts' K/V landed in pages the slot already
     owns, and writes past its span dropped at the page-table mask.
     """
-    params = dequantize_params(params)
-    draft_params = dequantize_params(draft_params)
+    params = materialize_for_program(params, model.cfg)
+    draft_params = materialize_for_program(draft_params, draft_model.cfg)
     max_pos = model.cfg.max_seq_len - 1
 
     def round_body(carry, _):

@@ -99,6 +99,12 @@ def pytest_configure(config):
         "in-kernel int8 dequant + tiled softmax, interpret mode on "
         "this tier) — `pytest -m pallas` runs it as a fast targeted "
         "subset")
+    config.addinivalue_line(
+        "markers", "matmul: the pallas fused dequant-matmul kernel "
+        "(matmul_kernel='pallas': int8/int4 weight codes + group "
+        "scales streamed into the projection matmuls, no materialized "
+        "dequant pass; interpret mode on this tier) — `pytest -m "
+        "matmul` runs it as a fast targeted subset")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,58 @@
+"""Lint: every pallas kernel module carries an interpret-mode
+bitwise-identity test.
+
+Sibling of the ``test_lint_*`` family. The repo's kernel contract
+(``docs/serving.md``) is that every hand-tiled pallas kernel in
+``models/pallas_*.py`` is, under interpret mode on the CPU tier,
+BITWISE its XLA reference path — that is what upgrades the serve
+suites' token pins from an agreement gate to an enforced
+0-mismatches identity. A kernel module that ships without such a test
+silently downgrades the contract (the engine pins would still pass on
+agreeing-but-unverified math until a config drifts), so this lint
+makes the pairing structural:
+
+for every ``ray_lightning_tpu/models/pallas_<name>.py`` there must be
+a ``tests/test_pallas_<name>.py`` that
+
+- imports the kernel module (references ``pallas_<name>``),
+- runs it under **interpret mode** (mentions ``interpret``), and
+- asserts bitwise equality against a reference
+  (``jnp.array_equal`` / ``np.array_equal`` — allclose does not
+  count: the identity contract is exact, not approximate).
+
+``pallas_attention`` and ``pallas_matmul`` both satisfy it today; a
+future kernel module fails this lint until its identity test lands.
+"""
+import pathlib
+import re
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+KERNELS = sorted(
+    (ROOT / "ray_lightning_tpu" / "models").glob("pallas_*.py"))
+
+
+def test_kernel_modules_discovered():
+    names = [p.stem for p in KERNELS]
+    assert "pallas_attention" in names and "pallas_matmul" in names
+
+
+@pytest.mark.parametrize("module", KERNELS, ids=lambda p: p.stem)
+def test_every_pallas_kernel_has_bitwise_identity_test(module):
+    test_path = ROOT / "tests" / f"test_{module.stem}.py"
+    assert test_path.exists(), (
+        f"kernel module models/{module.stem}.py has no "
+        f"tests/test_{module.stem}.py — every pallas kernel needs an "
+        "interpret-mode bitwise-identity test (the contract that lets "
+        "the serve suites ENFORCE 0 token mismatches; docs/serving.md)")
+    src = test_path.read_text()
+    assert re.search(rf"\b{module.stem}\b", src), (
+        f"tests/test_{module.stem}.py never references {module.stem}")
+    assert "interpret" in src, (
+        f"tests/test_{module.stem}.py has no interpret-mode coverage — "
+        "the CPU tier's identity contract runs the kernel under "
+        "pallas interpret mode")
+    assert re.search(r"\b(jnp|np)\.array_equal\b", src), (
+        f"tests/test_{module.stem}.py asserts no bitwise equality "
+        "(array_equal) — allclose is not an identity contract")
