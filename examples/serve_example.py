@@ -87,11 +87,57 @@ def main():
                              "arena, the per-dispatch param stream is "
                              "the codes+scales floor (interpret mode "
                              "off-TPU; tokens identical either way).")
+    parser.add_argument("--tenant-classes", default=None,
+                        help="arm multi-tenant SLO-aware scheduling: "
+                             "comma-separated 'name:tier[:weight]' "
+                             "entries, tier in {interactive,batch} "
+                             "(e.g. 'fast:interactive:4,bulk:batch:1' "
+                             "— interactive drains first, weights set "
+                             "fair share within a tier, batch is "
+                             "starvation-bounded). Scheduling is "
+                             "ordering-only: tokens are identical to "
+                             "the untenanted run, so the greedy "
+                             "generate() check still holds "
+                             "(docs/serving.md#multi-tenant-"
+                             "scheduling).")
+    parser.add_argument("--tenant", default=None,
+                        help="comma-separated class-name cycle assigned "
+                             "round-robin across the trace (needs "
+                             "--tenant-classes; default: cycle every "
+                             "declared class, a mixed "
+                             "interactive+batch trace).")
     parser.add_argument("--max-epochs", type=int, default=1)
     args = parser.parse_args()
     if args.matmul_kernel == "pallas" and args.weight_dtype is None:
         parser.error("--matmul-kernel pallas needs --weight-dtype "
                      "(the fused kernel consumes quantized codes)")
+    if args.tenant is not None and args.tenant_classes is None:
+        parser.error("--tenant needs --tenant-classes (it names "
+                     "classes that flag declares)")
+    tenant_classes = None
+    tenant_cycle = []
+    if args.tenant_classes is not None:
+        from ray_lightning_tpu.serve import TenantClass
+        tenant_classes = []
+        for spec in args.tenant_classes.split(","):
+            parts = spec.strip().split(":")
+            if len(parts) not in (2, 3):
+                parser.error(f"bad --tenant-classes entry {spec!r}: "
+                             "expected name:tier[:weight]")
+            try:
+                tenant_classes.append(TenantClass(
+                    parts[0], tier=parts[1],
+                    weight=float(parts[2]) if len(parts) == 3 else 1.0))
+            except ValueError as exc:
+                parser.error(f"bad --tenant-classes entry {spec!r}: "
+                             f"{exc}")
+        tenant_cycle = (args.tenant.split(",") if args.tenant
+                        else [c.name for c in tenant_classes])
+        declared = {c.name for c in tenant_classes} | {"default"}
+        unknown = [t for t in tenant_cycle if t not in declared]
+        if unknown:
+            parser.error(f"--tenant names undeclared classes {unknown} "
+                         f"(declared: {sorted(declared)})")
 
     from ray_lightning_tpu import RayStrategy, Trainer
     from ray_lightning_tpu.models import GPTModule, TransformerLM, gpt2_config
@@ -123,10 +169,14 @@ def main():
         plen = int(rng.integers(2, args.prefill_len + 1))
         prompt = [int(t) for t in rng.integers(0, 256, size=plen)]
         greedy = i % 2 == 0
-        trace.append((i * args.gap, dict(
-            prompt=prompt, max_new_tokens=args.max_new,
-            temperature=0.0 if greedy else 0.8,
-            top_k=None if greedy else 20)))
+        kw = dict(prompt=prompt, max_new_tokens=args.max_new,
+                  temperature=0.0 if greedy else 0.8,
+                  top_k=None if greedy else 20)
+        if tenant_cycle:
+            # round-robin class assignment: a mixed interactive+batch
+            # trace by default, or whatever cycle --tenant names
+            kw["tenant"] = tenant_cycle[i % len(tenant_cycle)]
+        trace.append((i * args.gap, kw))
 
     # --attention-kernel selects the page-native read-side kernel; the
     # page-native layout it rides on needs a paged arena, so the flag
@@ -144,6 +194,7 @@ def main():
         weight_dtype=args.weight_dtype,
         weight_group_size=args.weight_group_size,
         matmul_kernel=args.matmul_kernel, **paged_kw,
+        tenant_classes=tenant_classes,
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))
     t0 = time.perf_counter()
@@ -156,10 +207,23 @@ def main():
           f"{client.engine.steps} decode steps)")
     for rid in sorted(out):
         c = out[rid]
+        cls = f" [{c.tenant}]" if tenant_classes else ""
         print(f"  req {rid:2d}: prompt {len(c.prompt):2d} toks -> "
               f"{len(c.tokens):2d} generated ({c.finish_reason}), "
               f"latency {c.latency:.0f} ticks, "
-              f"ttft {c.time_to_first_token:.0f} ticks")
+              f"ttft {c.time_to_first_token:.0f} ticks{cls}")
+
+    if tenant_classes:
+        # per-class rollup: interactive classes should show the lower
+        # TTFTs — that ordering is what the tiers buy
+        print("\nper-tenant (tier/weight -> served, mean ttft):")
+        for cls in tenant_classes:
+            comps = [c for c in out.values() if c.tenant == cls.name]
+            ttfts = [c.time_to_first_token for c in comps
+                     if c.time_to_first_token is not None]
+            mean = (sum(ttfts) / len(ttfts)) if ttfts else float("nan")
+            print(f"  {cls.name:>8s} ({cls.tier}, w={cls.weight:g}): "
+                  f"{len(comps):2d} served, mean ttft {mean:.1f} ticks")
 
     # 4) verify greedy rows against one-shot generate(), and show what
     #    the static batch costs: it cannot start before the LAST arrival.

@@ -17,13 +17,16 @@ from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetSaturated,
                                            ReplicaFleet, Router,
                                            RouterConfig)
 from ray_lightning_tpu.serve.pages import PagePool, PrefixCache
-from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
+from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
+                                             FINISH_EOS,
                                              FINISH_FAILED, FINISH_LENGTH,
                                              FINISH_REJECTED,
                                              FINISH_TIMEOUT, Request)
 from ray_lightning_tpu.serve.scheduler import (FifoScheduler, QueueFull,
                                                SchedulerConfig)
 from ray_lightning_tpu.serve.spec import SpecDecoder
+from ray_lightning_tpu.serve.tenancy import (ClassQueueFull, TenantClass,
+                                             TenantScheduler)
 
 __all__ = [
     "ServeClient", "ServeEngine", "KVSlotPool", "PagePool", "PrefixCache",
@@ -31,6 +34,7 @@ __all__ = [
     "Completion",
     "FifoScheduler", "QueueFull", "SchedulerConfig", "ReplicaFleet",
     "Router", "RouterConfig", "FleetConfig", "FleetSaturated",
+    "TenantClass", "TenantScheduler", "ClassQueueFull", "DEFAULT_TENANT",
     "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",
     "FINISH_TIMEOUT",
 ]

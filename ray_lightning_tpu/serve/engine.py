@@ -123,9 +123,10 @@ from ray_lightning_tpu.serve.spec import (SpecDecoder,
                                           _spec_paged_plain,
                                           _spec_rounds_donated,
                                           _spec_rounds_plain)
-from ray_lightning_tpu.serve.request import (Completion, FINISH_EOS,
-                                             FINISH_LENGTH, FINISH_TIMEOUT,
-                                             Request)
+from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
+                                             FINISH_EOS, FINISH_LENGTH,
+                                             FINISH_TIMEOUT, Request)
+from ray_lightning_tpu.serve.tenancy import resolve_tenant_classes
 
 __all__ = ["ServeEngine", "KVSlotPool", "SlotPoolFull", "PendingDispatch"]
 
@@ -613,7 +614,8 @@ class ServeEngine:
                  matmul_kernel: Optional[str] = None,
                  draft_model=None, draft_params=None,
                  spec_k: Optional[int] = None,
-                 draft_weight_dtype: Optional[str] = None):
+                 draft_weight_dtype: Optional[str] = None,
+                 tenant_classes=None):
         cfg = model.cfg
         if not cfg.decode:
             raise ValueError(
@@ -746,6 +748,16 @@ class ServeEngine:
             raise ValueError(
                 "draft_weight_dtype is a speculative-decoding option: "
                 "pass draft_model=/draft_params= to enable it")
+        # multi-tenant scheduling (serve/tenancy.py): the engine keeps
+        # the resolved class map so validate() refuses unknown tenants
+        # and prefill() enforces per-class max_active_slots even for
+        # direct (non-ServeClient) callers. The map rides engine_kwargs
+        # through supervisor rebuilds and fleet replicas, so recovery
+        # re-admission keeps every request's class enforceable.
+        # Scheduling policy itself lives in the TenantScheduler — the
+        # engine only enforces quotas, it never reorders anything.
+        self.tenant_classes = (resolve_tenant_classes(tenant_classes)
+                               if tenant_classes else None)
         self.model = model
         # weight-only quantization (models/quant.py): storage-only —
         # the programs dequantize once per dispatch, compute stays at
@@ -989,8 +1001,20 @@ class ServeEngine:
         return self.prefill_len
 
     def validate(self, request: Request) -> None:
-        """Admission check: the request must fit the compiled shapes."""
+        """Admission check: the request must fit the compiled shapes
+        (and, tenancy configured, name a declared tenant class)."""
         cfg = self.model.cfg
+        tenant = getattr(request, "tenant", DEFAULT_TENANT)
+        if self.tenant_classes is not None:
+            if tenant not in self.tenant_classes:
+                raise ValueError(
+                    f"unknown tenant {tenant!r}: this engine's declared "
+                    f"classes are {list(self.tenant_classes)}")
+        elif tenant != DEFAULT_TENANT:
+            raise ValueError(
+                f"request names tenant {tenant!r} but the engine has no "
+                "tenant classes configured — pass tenant_classes= to "
+                "arm multi-tenant scheduling")
         if self.prefill_chunk is None \
                 and request.prompt_len > self.prefill_len:
             raise ValueError(
@@ -1022,6 +1046,27 @@ class ServeEngine:
                     f"{self.pool.num_pages} — it can never be admitted")
 
     # ------------------------------------------------------- admission
+    def _check_slot_quota(self, request: Request) -> None:
+        """Per-class ``max_active_slots`` enforcement at admission
+        (tenancy configured): the class may not hold more concurrent KV
+        slots than its quota. The TenantScheduler's selection already
+        respects this, so the scheduler-driven path never trips it —
+        this is the loud defense for direct ``engine.prefill`` callers,
+        raising inside the atomic-admission try block so the batch
+        rolls back cleanly."""
+        if self.tenant_classes is None:
+            return
+        cls = self.tenant_classes[request.tenant]
+        if cls.max_active_slots is None:
+            return
+        held = sum(1 for r in self.pool.active.values()
+                   if r.tenant == request.tenant)
+        if held >= cls.max_active_slots:
+            raise SlotPoolFull(
+                f"tenant {request.tenant!r} at max_active_slots="
+                f"{cls.max_active_slots}", tenant=request.tenant,
+                slots_free=self.free_slots, active=len(self.pool.active))
+
     def _routes_chunked(self, request: Request) -> bool:
         """Chunk-prefill routing: everything when the prefix cache is on
         (published pages must all come from the one chunk program), else
@@ -1138,6 +1183,7 @@ class ServeEngine:
         try:
             for req in requests:
                 self.validate(req)
+                self._check_slot_quota(req)
                 replay = list(req.replay_tokens or ())
                 fed = list(req.prompt) + replay
                 if self._routes_chunked(req):
@@ -1753,4 +1799,5 @@ class ServeEngine:
             request_id=req.id, prompt=list(req.prompt), tokens=tokens,
             finish_reason=reason, arrival_time=req.arrival_time,
             first_token_time=req.first_token_time,
-            prefix_hit_tokens=req.prefix_hit_tokens)
+            prefix_hit_tokens=req.prefix_hit_tokens,
+            tenant=req.tenant)

@@ -74,7 +74,8 @@ from ray_lightning_tpu.reliability.faults import (InjectedFault, MODE_STALL,
 # serve package → this module → gang → supervisor) when the first import
 # of the repo enters through the reliability package.
 from ray_lightning_tpu.serve.client import ServeClient
-from ray_lightning_tpu.serve.request import (Completion, FINISH_REJECTED,
+from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
+                                             FINISH_REJECTED,
                                              OccupancyError, Request)
 from ray_lightning_tpu.serve.scheduler import ACTION_IDLE, QueueFull
 
@@ -112,11 +113,18 @@ class FleetSaturated(QueueFull):
     def __init__(self, message: str, *,
                  queue_depth: Optional[int] = None,
                  oldest_age: Optional[float] = None,
-                 replicas: Optional[int] = None):
+                 replicas: Optional[int] = None,
+                 class_depths: Optional[dict] = None,
+                 class_oldest: Optional[dict] = None):
         # skip QueueFull.__init__ (narrower kwargs): the OccupancyError
-        # base renders any context
+        # base renders any context. Tenancy armed, ``class_depths`` /
+        # ``class_oldest`` aggregate the per-class queue depths and
+        # oldest head ages across every offered replica, so shed
+        # logging names the saturated CLASS, not just the fleet totals.
         OccupancyError.__init__(self, message, queue_depth=queue_depth,
-                                oldest_age=oldest_age, replicas=replicas)
+                                oldest_age=oldest_age, replicas=replicas,
+                                class_depths=class_depths,
+                                class_oldest=class_oldest)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,6 +197,19 @@ class Router:
                 + engine.chunk_pending)
 
     @staticmethod
+    def class_load(replica: "_Replica", request: Request) -> int:
+        """Waiting requests of ``request``'s own tenant class on this
+        replica (0 without a tenant scheduler — untenanted routing is
+        byte-identical to the pre-tenancy order). The tenant-aware
+        tiebreak: among equally loaded replicas, a class's requests
+        steer away from the replica where THAT class is backed up
+        (and closest to its per-class quota shedding them)."""
+        depths = getattr(replica.client.scheduler, "class_depths", None)
+        if depths is None:
+            return 0
+        return depths().get(request.tenant, 0)
+
+    @staticmethod
     def occupancy(replica: "_Replica") -> float:
         """Paged-arena page occupancy in [0, 1] (0.0 on dense engines):
         the tiebreak that steers work away from arenas running out of
@@ -221,7 +242,8 @@ class Router:
         down this list — a refusal sheds to the next candidate."""
         ranked = sorted(
             (r for r in replicas if r.admitting),
-            key=lambda r: (self.load(r), self.occupancy(r),
+            key=lambda r: (self.load(r), self.class_load(r, request),
+                           self.occupancy(r),
                            self._ttft.get(r.id, 0.0), r.id))
         rid = self.affine_target(request)
         if rid is not None:
@@ -552,14 +574,19 @@ class ReplicaFleet:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: float = 0.0, top_k: Optional[int] = None,
                eos_id: Optional[int] = None, seed: Optional[int] = None,
-               deadline: Optional[float] = None) -> int:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None) -> int:
         """Route + enqueue one request; returns its fleet-wide id.
         Raises ``ValueError`` for requests no replica could ever fit
-        and :class:`FleetSaturated` when every replica refuses."""
+        (or that name an undeclared tenant) and
+        :class:`FleetSaturated` when every replica refuses — a class at
+        its per-replica quota sheds ``ClassQueueFull`` to the next
+        candidate exactly like any other refusal."""
         req = Request(id=self._next_id, prompt=prompt,
                       max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, eos_id=eos_id,
-                      seed=seed, deadline=deadline)
+                      seed=seed, deadline=deadline,
+                      tenant=tenant or DEFAULT_TENANT)
         self._admit(req)
         self._next_id += 1
         return req.id
@@ -586,10 +613,24 @@ class ReplicaFleet:
         oldest = [r.client.scheduler.oldest_age(now)
                   for r in self._replicas]
         oldest = [a for a in oldest if a is not None]
+        # tenancy armed: aggregate the per-class breakdown across every
+        # replica so the shed log names the saturated class
+        class_depths: Dict[str, int] = {}
+        class_oldest: Dict[str, float] = {}
+        for r in self._replicas:
+            sched = r.client.scheduler
+            if getattr(sched, "class_depths", None) is None:
+                continue
+            for name, depth in sched.class_depths().items():
+                class_depths[name] = class_depths.get(name, 0) + depth
+            for name, age in sched.class_oldest(now).items():
+                class_oldest[name] = max(class_oldest.get(name, age), age)
         raise FleetSaturated(
             "every replica's admission control refused the request",
             queue_depth=total, oldest_age=max(oldest) if oldest else None,
-            replicas=len(ranked))
+            replicas=len(ranked),
+            class_depths=class_depths or None,
+            class_oldest=class_oldest or None)
 
     # ------------------------------------------------------------- loop
     def tick(self) -> List[Completion]:
@@ -951,7 +992,8 @@ class ReplicaFleet:
                         request_id=rid,
                         prompt=[int(t) for t in kwargs.get("prompt", [])],
                         tokens=[], finish_reason=FINISH_REJECTED,
-                        arrival_time=now, finish_time=now)
+                        arrival_time=now, finish_time=now,
+                        tenant=kwargs.get("tenant") or DEFAULT_TENANT)
                     if tel is not None:
                         tel.event(EVENT_SHED, id=rid,
                                   why=type(exc).__name__,

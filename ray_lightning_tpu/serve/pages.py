@@ -266,10 +266,12 @@ class SlotPoolFull(OccupancyError):
     def __init__(self, message: str, *, slots_free: Optional[int] = None,
                  pages_free: Optional[int] = None,
                  pages_needed: Optional[int] = None,
-                 active: Optional[int] = None):
+                 active: Optional[int] = None, **ctx):
+        # **ctx: the tenancy layer extends the context (e.g. the tenant
+        # whose max_active_slots quota refused the admission)
         super().__init__(message, slots_free=slots_free,
                          pages_free=pages_free, pages_needed=pages_needed,
-                         active=active)
+                         active=active, **ctx)
 
 
 def fold_rows(keys: jax.Array, data: jax.Array) -> jax.Array:

@@ -18,6 +18,12 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Sequence
 
+#: the tenant class a request belongs to when none is named — engines
+#: without tenancy configured only ever see this class, and a
+#: TenantScheduler holding only this class is behaviorally identical
+#: to the FIFO scheduler (see ray_lightning_tpu/serve/tenancy.py)
+DEFAULT_TENANT = "default"
+
 FINISH_EOS = "eos"            # sampled its eos id
 FINISH_LENGTH = "length"      # exhausted max_new_tokens
 FINISH_TIMEOUT = "timeout"    # deadline expired (queued or mid-decode)
@@ -65,6 +71,12 @@ class Request:
     eos_id: Optional[int] = None
     seed: Optional[int] = None
     deadline: Optional[float] = None
+    # tenant class (multi-tenant scheduling, serve/tenancy.py): which
+    # per-class queue/quota/fair-share bucket this request rides.
+    # Scheduling is ordering-only — the tenant never changes the
+    # request's tokens — and the class assignment rides the request
+    # object through crash replay and fleet failover re-admission.
+    tenant: str = DEFAULT_TENANT
     # timing bookkeeping, stamped by the driving client (clock units)
     arrival_time: Optional[float] = None
     first_token_time: Optional[float] = None
@@ -91,6 +103,9 @@ class Request:
                 f"temperature must be >= 0, got {self.temperature}")
         if self.top_k is not None and self.top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError(
+                f"tenant must be a non-empty string, got {self.tenant!r}")
         if self.seed is None:
             self.seed = self.id
 
@@ -112,6 +127,9 @@ class Completion:
     # prompt tokens served from the shared-prefix KV cache (paged
     # engines with prefix_cache=True; 0 otherwise)
     prefix_hit_tokens: int = 0
+    # the retiring request's tenant class (per-tenant obs + bench
+    # aggregation key; DEFAULT_TENANT without tenancy configured)
+    tenant: str = DEFAULT_TENANT
 
     @property
     def latency(self) -> Optional[float]:

@@ -60,9 +60,13 @@ class QueueFull(OccupancyError):
     """
 
     def __init__(self, message: str, *, queue_depth: Optional[int] = None,
-                 oldest_age: Optional[float] = None):
+                 oldest_age: Optional[float] = None, **ctx):
+        # **ctx: subclasses and the tenancy layer extend the shed
+        # context (per-class queue depths / oldest-age breakdown, the
+        # saturated class's name) — the OccupancyError base renders any
+        # keys into the message suffix and exposes them as attributes
         super().__init__(message, queue_depth=queue_depth,
-                         oldest_age=oldest_age)
+                         oldest_age=oldest_age, **ctx)
 
 
 @dataclasses.dataclass
@@ -125,11 +129,25 @@ class FifoScheduler:
                 f"queue at max_queue_depth={self.config.max_queue_depth}",
                 queue_depth=len(self._queue),
                 oldest_age=self.oldest_age(now))
-        if (request.deadline is None
-                and self.config.default_deadline is not None
-                and now is not None):
-            request.deadline = now + self.config.default_deadline
+        self._stamp_admission(request, now, self.config.default_deadline)
         self._queue.append(request)
+
+    @staticmethod
+    def _stamp_admission(request: Request, now: Optional[float],
+                         deadline_offset: Optional[float]) -> None:
+        """The one copy of admission stamping, shared with the tenancy
+        scheduler (which passes its per-class deadline offset) so the
+        two submit paths cannot drift: apply the default deadline as an
+        offset from ``now``, and stamp arrival at admission so
+        ``oldest_age`` works for direct scheduler callers too (the
+        driving client's own post-submit stamp uses the same ``now``,
+        so this is a no-op there)."""
+        if now is None:
+            return
+        if request.deadline is None and deadline_offset is not None:
+            request.deadline = now + deadline_offset
+        if request.arrival_time is None:
+            request.arrival_time = now
 
     def requeue_front(self, requests: List[Request]) -> None:
         """Put popped-but-not-dispatched requests back at the queue head

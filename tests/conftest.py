@@ -105,6 +105,11 @@ def pytest_configure(config):
         "scales streamed into the projection matmuls, no materialized "
         "dequant pass; interpret mode on this tier) — `pytest -m "
         "matmul` runs it as a fast targeted subset")
+    config.addinivalue_line(
+        "markers", "tenancy: multi-tenant SLO-aware scheduling "
+        "(TenantClass tiers/weights/quotas, deficit-weighted fair "
+        "share, class-aware admission control, per-tenant obs) — "
+        "`pytest -m tenancy` runs it as a fast targeted subset")
 
 
 @pytest.fixture(scope="session")
