@@ -2672,6 +2672,163 @@ def _bench_fleet(num_replicas: int = 3, n_requests: int = 12,
     }
 
 
+def _bench_fleet_scaling(n_requests: int = 24, prompt: int = 16,
+                         new_tokens: int = 24,
+                         steps_per_dispatch: int = 4) -> dict:
+    """Process-backend fleet scaling: 1 engine vs N=2 replica processes.
+
+    PR 16's claim is dispatch concurrency, not model-compute magic: the
+    in-process fleet interleaves replica dispatches on one Python
+    thread, so N replicas never exceeded ~1x one engine's tokens/sec.
+    ``ReplicaFleet(backend="process")`` runs one dispatch process per
+    replica; under a saturating trace (every request arrives at t=0)
+    N processes should approach N x one engine.
+
+    Honesty guards:
+
+    - The model is a **nano** transformer, deliberately sized so decode
+      is host-dispatch-bound — the regime the process backend targets
+      (a compute-bound model would be measuring XLA, not dispatch).
+      The in-process fleet's number is recorded alongside so the
+      single-thread baseline is visible, not hidden.
+    - The >= 1.6x floor on ``process_vs_single_engine`` is ENFORCED
+      only when the host exposes >= 2 CPU cores
+      (``os.sched_getaffinity``): two dispatch processes on one core
+      time-slice, they cannot scale, and pretending otherwise would be
+      the round-1 clamp all over again. On a 1-core host the measured
+      ratio is still recorded with ``enforced: False`` and the reason.
+    - Greedy token identity between the process fleet and the
+      in-process fleet is enforced at **0 mismatches on every host** —
+      the boundary must not change a single sampled token.
+
+    Makespans are ``max(finish) - min(arrival)`` per pass (process-
+    fleet stamps are wall seconds from fleet construction, so pass-2
+    timing needs the relative form)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.serve import ReplicaFleet, ServeClient
+
+    num_replicas = 2
+    num_slots = 4  # per replica AND for the single engine: the claim
+    # is "N processes ~= N x one process", so every seat is one
+    # replica's config — the single engine does NOT get N x slots here
+    # (that comparison lives in _bench_fleet)
+    total = prompt + new_tokens
+    base = dict(vocab_size=512, max_seq_len=total + 8, dtype=jnp.float32,
+                scan_layers=False)
+    model = TransformerLM(gpt2_config("nano", **base))
+    params = jax.device_put(model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((num_slots, 8), np.int32))["params"])
+    dec = TransformerLM(gpt2_config("nano", decode=True, **base))
+
+    rng = np.random.default_rng(16)
+    trace = []
+    for _ in range(n_requests):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        trace.append((0.0, dict(  # saturating: everything due at t=0
+            prompt=[int(t) for t in rng.integers(0, 512, size=L)],
+            max_new_tokens=int(rng.integers(new_tokens // 2,
+                                            new_tokens + 1)))))
+    kw = dict(num_slots=num_slots, prefill_len=total,
+              steps_per_dispatch=steps_per_dispatch)
+
+    def span(out):
+        done = [c for c in out.values() if c.finish_time is not None]
+        if len(done) != len(out):
+            raise MeasurementError(
+                f"scaling leg dropped {len(out) - len(done)}/"
+                f"{len(out)} completions — makespan would lie")
+        return (max(c.finish_time for c in done)
+                - min(c.arrival_time for c in done))
+
+    def run_single():
+        client = ServeClient(dec, params, clock=time.perf_counter, **kw)
+        out = client.serve_trace(trace)
+        client.shutdown()
+        return out
+
+    def run_inproc():
+        fleet = ReplicaFleet(dec, params, num_replicas=num_replicas,
+                             clock=time.perf_counter, **kw)
+        out = fleet.serve_trace(trace)
+        fleet.shutdown()
+        return out
+
+    run_single()  # warmup: compiles the nano prefill + K-step programs
+    single_out = run_single()
+    run_inproc()
+    inproc_out = run_inproc()
+
+    # one spawn, two passes: worker processes compile on pass 1, pass 2
+    # is the measurement. Completions accumulate across passes, so the
+    # measured pass is the id-diff.
+    pfleet = ReplicaFleet(dec, params, backend="process",
+                          num_replicas=num_replicas, **kw)
+    try:
+        warm = pfleet.serve_trace(trace)
+        steps0 = dict(pfleet.replica_steps)
+        both = pfleet.serve_trace(trace)
+        proc_out = {r: c for r, c in both.items() if r not in warm}
+        per_replica_steps = {
+            rid: s - steps0.get(rid, 0)
+            for rid, s in pfleet.replica_steps.items()}
+    finally:
+        pfleet.shutdown()
+
+    # token identity vs the in-process fleet: ids are per-instance
+    # monotone in submit order and every arrival is t=0, so the sorted
+    # positions of any two passes align on the same trace entry
+    mismatched = sum(
+        1 for a, b in zip(sorted(inproc_out), sorted(proc_out))
+        if inproc_out[a].tokens != proc_out[b].tokens
+        or inproc_out[a].finish_reason != proc_out[b].finish_reason)
+    if mismatched:
+        raise MeasurementError(
+            f"process-backend fleet diverged from the in-process fleet "
+            f"on {mismatched}/{n_requests} requests in fp32 greedy — "
+            "the boundary changed tokens, timing is meaningless")
+
+    single_s, inproc_s, proc_s = (span(single_out), span(inproc_out),
+                                  span(proc_out))
+    tokens_total = sum(len(c.tokens) for c in proc_out.values())
+    ratio = single_s / proc_s
+    steps_sum = max(1, sum(per_replica_steps.values()))
+    result = {
+        "model": "gpt2_nano fp32 (dispatch-bound by design)",
+        "replicas": num_replicas, "slots_per_replica": num_slots,
+        "requests": n_requests,
+        "single_engine_tokens_per_sec": round(tokens_total / single_s, 0),
+        "inproc_fleet_tokens_per_sec": round(tokens_total / inproc_s, 0),
+        "process_fleet_tokens_per_sec": round(tokens_total / proc_s, 0),
+        "process_vs_single_engine": round(ratio, 2),
+        "inproc_vs_single_engine": round(single_s / inproc_s, 2),
+        "per_replica_dispatch_turns": per_replica_steps,
+        "per_replica_utilization": {
+            rid: round(s / steps_sum, 2)
+            for rid, s in per_replica_steps.items()},
+        "token_mismatches_vs_inproc": mismatched,
+    }
+    cores = len(os.sched_getaffinity(0))
+    if cores >= 2:
+        result["enforced"] = True
+        if ratio < 1.6:
+            raise MeasurementError(
+                f"process-backend scaling {ratio:.2f}x < 1.6x single "
+                f"engine on a {cores}-core host — the per-replica "
+                "dispatch processes are not actually concurrent")
+    else:
+        result["enforced"] = False
+        result["skipped_reason"] = (
+            f"host exposes {cores} CPU core(s); two dispatch processes "
+            "time-slice one core, so the 1.6x floor cannot be measured "
+            "here — ratio recorded honestly, identity still enforced")
+    return result
+
+
 def _bench_gang() -> dict:
     """Gang kill-and-restart cost on the process backend: cold vs warm.
 
@@ -3511,6 +3668,16 @@ def main() -> None:
                 extras["fleet"]["fleet_failover_ms"]
     except Exception as exc:
         extras["fleet"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        # PR 16 scaling leg: 1 engine vs N=2 process-backend replica
+        # processes under a saturating trace. Its >=1.6x floor raises
+        # MeasurementError on multi-core hosts; identity vs the
+        # in-process fleet is enforced everywhere.
+        extras["fleet"]["scaling"] = _bench_fleet_scaling()
+    except Exception as exc:
+        extras["fleet"]["scaling"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
 
     try:
         # gang kill-and-restart on the process backend, untracked

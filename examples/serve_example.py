@@ -8,6 +8,8 @@ MID-FLIGHT while earlier requests are still decoding, and finished
 requests hand their KV slot to the next one without any recompilation.
 
     python examples/serve_example.py --num-slots 4 --requests 12
+    python examples/serve_example.py --fleet-replicas 2 \
+        --fleet-backend process   # one dispatch process per replica
 
 The same trace is replayed as a static batch (one-shot ``generate()``
 that must wait for the LAST arrival before starting) so the makespan
@@ -106,8 +108,25 @@ def main():
                              "--tenant-classes; default: cycle every "
                              "declared class, a mixed "
                              "interactive+batch trace).")
+    parser.add_argument("--fleet-replicas", type=int, default=0,
+                        help="serve the trace through an N-replica "
+                             "ReplicaFleet instead of one ServeClient "
+                             "(0 = off). Greedy rows stay verified "
+                             "against generate() — the router changes "
+                             "placement, never tokens.")
+    parser.add_argument("--fleet-backend", default="inproc",
+                        choices=["inproc", "process"],
+                        help="with --fleet-replicas: 'inproc' drives "
+                             "every replica on this thread (tick "
+                             "clock); 'process' gives each replica its "
+                             "own dispatch process (wall clock, "
+                             "queue-transport results, ~15s spawn + "
+                             "per-worker compile on CPU — "
+                             "docs/serving.md#replica-fleet).")
     parser.add_argument("--max-epochs", type=int, default=1)
     args = parser.parse_args()
+    if args.fleet_backend == "process" and not args.fleet_replicas:
+        parser.error("--fleet-backend process needs --fleet-replicas N")
     if args.matmul_kernel == "pallas" and args.weight_dtype is None:
         parser.error("--matmul-kernel pallas needs --weight-dtype "
                      "(the fused kernel consumes quantized codes)")
@@ -186,8 +205,8 @@ def main():
     if args.attention_kernel is not None:
         paged_kw = dict(page_size=16, page_native=True,
                         attention_kernel=args.attention_kernel)
-    client = ServeClient(
-        dec, params, num_slots=args.num_slots,
+    engine_kw = dict(
+        num_slots=args.num_slots,
         prefill_len=args.prefill_len,
         steps_per_dispatch=args.steps_per_dispatch,
         async_dispatch=args.async_dispatch,
@@ -197,21 +216,43 @@ def main():
         tenant_classes=tenant_classes,
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))
-    t0 = time.perf_counter()
-    out = client.serve_trace(trace)
-    serve_wall = time.perf_counter() - t0
+    unit, ufmt = "ticks", ".0f"
+    if args.fleet_replicas:
+        from ray_lightning_tpu.serve import ReplicaFleet
+        wall = args.fleet_backend == "process"
+        if wall:
+            # process replicas run on a wall clock: reinterpret the
+            # tick gaps as 20 ms each so arrivals still stagger
+            trace = [(t * 0.02, kw) for t, kw in trace]
+            unit, ufmt = "s", ".2f"
+        fleet = ReplicaFleet(dec, params, backend=args.fleet_backend,
+                             num_replicas=args.fleet_replicas,
+                             **engine_kw)
+        t0 = time.perf_counter()
+        out = fleet.serve_trace(trace)
+        serve_wall = time.perf_counter() - t0
+        detail = (f"{args.fleet_replicas} {args.fleet_backend} replicas"
+                  + (f", dispatch turns {fleet.replica_steps}" if wall
+                     else ""))
+        fleet.shutdown()
+    else:
+        client = ServeClient(dec, params, **engine_kw)
+        t0 = time.perf_counter()
+        out = client.serve_trace(trace)
+        serve_wall = time.perf_counter() - t0
+        detail = (f"{client.engine.prefills} prefills, "
+                  f"{client.engine.steps} decode steps")
     total_tokens = sum(len(c.tokens) for c in out.values())
 
     print(f"\nserved {len(out)} requests / {total_tokens} tokens in "
-          f"{serve_wall:.2f}s wall ({client.engine.prefills} prefills, "
-          f"{client.engine.steps} decode steps)")
+          f"{serve_wall:.2f}s wall ({detail})")
     for rid in sorted(out):
         c = out[rid]
         cls = f" [{c.tenant}]" if tenant_classes else ""
         print(f"  req {rid:2d}: prompt {len(c.prompt):2d} toks -> "
               f"{len(c.tokens):2d} generated ({c.finish_reason}), "
-              f"latency {c.latency:.0f} ticks, "
-              f"ttft {c.time_to_first_token:.0f} ticks{cls}")
+              f"latency {c.latency:{ufmt}} {unit}, "
+              f"ttft {c.time_to_first_token:{ufmt}} {unit}{cls}")
 
     if tenant_classes:
         # per-class rollup: interactive classes should show the lower
@@ -223,7 +264,7 @@ def main():
                      if c.time_to_first_token is not None]
             mean = (sum(ttfts) / len(ttfts)) if ttfts else float("nan")
             print(f"  {cls.name:>8s} ({cls.tier}, w={cls.weight:g}): "
-                  f"{len(comps):2d} served, mean ttft {mean:.1f} ticks")
+                  f"{len(comps):2d} served, mean ttft {mean:.1f} {unit}")
 
     # 4) verify greedy rows against one-shot generate(), and show what
     #    the static batch costs: it cannot start before the LAST arrival.

@@ -1,10 +1,14 @@
 from ray_lightning_tpu.launchers.utils import WorkerOutput, find_free_port
 from ray_lightning_tpu.launchers.local import LocalLauncher
+from ray_lightning_tpu.launchers.process_backend import ProcessRay
 from ray_lightning_tpu.launchers.ray_launcher import (ExecutorBase,
                                                       RayLauncher,
                                                       ray_available)
+from ray_lightning_tpu.launchers.serve_worker import (ServeReplicaWorker,
+                                                      default_worker_env)
 
 __all__ = [
     "WorkerOutput", "find_free_port", "LocalLauncher", "RayLauncher",
-    "ExecutorBase", "ray_available"
+    "ExecutorBase", "ray_available", "ProcessRay", "ServeReplicaWorker",
+    "default_worker_env",
 ]

@@ -138,7 +138,7 @@ class ProcessActorHandle:
     """One spawned process per actor; FIFO call pipeline + reader thread."""
 
     def __init__(self, cls: type, args: Tuple, kwargs: Dict,
-                 env: Dict[str, str]):
+                 env: Dict[str, str], construct_timeout: float = 60.0):
         ctx = mp.get_context("spawn")  # fork-unsafe with a live XLA backend
         self._conn, child_conn = ctx.Pipe()
         self._proc = ctx.Process(target=_worker_main,
@@ -155,7 +155,7 @@ class ProcessActorHandle:
         # construction is itself a pipelined call
         fut = self._enqueue(
             ("construct", pickle.dumps((cls, args, kwargs))))
-        fut.result(timeout=60)
+        fut.result(timeout=construct_timeout)
 
     def _enqueue(self, message: Tuple) -> ProcessFuture:
         """Append the future and send its request atomically: the worker
@@ -267,8 +267,18 @@ class ProcessRemoteClass:
         return out
 
     def remote(self, *args: Any, **kwargs: Any) -> ProcessActorHandle:
-        handle = ProcessActorHandle(self._cls, args, kwargs,
-                                    dict(self._backend.worker_env))
+        # honored options (Ray ignores unknown ones, so do we):
+        #   worker_env: per-actor env merged OVER the backend's env —
+        #     how a fleet/launcher pins each actor to its own device
+        #     slice (JAX_PLATFORMS, TPU visible-chip vars, seat ids)
+        #   construct_timeout: seconds the spawned process may take to
+        #     build the actor (model/engine construction crosses the
+        #     pickle boundary here, which can dwarf the 60 s default)
+        env = dict(self._backend.worker_env)
+        env.update(self._options.get("worker_env") or {})
+        handle = ProcessActorHandle(
+            self._cls, args, kwargs, env,
+            construct_timeout=self._options.get("construct_timeout", 60.0))
         self._backend.created_actors.append(handle)
         return handle
 

@@ -17,6 +17,7 @@ from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetSaturated,
                                            ReplicaFleet, Router,
                                            RouterConfig)
 from ray_lightning_tpu.serve.pages import PagePool, PrefixCache
+from ray_lightning_tpu.serve.process_fleet import ProcessReplicaFleet
 from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
                                              FINISH_EOS,
                                              FINISH_FAILED, FINISH_LENGTH,
@@ -33,6 +34,7 @@ __all__ = [
     "PendingDispatch", "SlotPoolFull", "SpecDecoder", "Request",
     "Completion",
     "FifoScheduler", "QueueFull", "SchedulerConfig", "ReplicaFleet",
+    "ProcessReplicaFleet",
     "Router", "RouterConfig", "FleetConfig", "FleetSaturated",
     "TenantClass", "TenantScheduler", "ClassQueueFull", "DEFAULT_TENANT",
     "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",

@@ -91,7 +91,7 @@ import warnings
 from collections import deque
 from dataclasses import dataclass
 from functools import partial
-from typing import Deque, FrozenSet, Dict, List, Optional, Tuple
+from typing import Any, Deque, FrozenSet, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -989,6 +989,19 @@ class ServeEngine:
     @property
     def chunk_pending_ids(self) -> FrozenSet[int]:
         return frozenset(st.request.id for st in self._chunk_queue)
+
+    def occupancy(self) -> Dict[str, Any]:
+        """Host-side occupancy snapshot: the engine half of the fleet
+        router's scoring signals (``ServeClient.load_stats`` adds the
+        scheduler half). Plain ints/None only — this dict crosses the
+        process-backend queue transport verbatim."""
+        return {
+            "active": self.active_count,
+            "chunk_pending": self.chunk_pending,
+            "free_slots": self.free_slots,
+            "free_pages": self.free_pages,
+            "num_pages": self.pool.num_pages if self.paged else None,
+        }
 
     @property
     def max_replay_len(self) -> int:

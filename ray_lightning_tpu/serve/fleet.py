@@ -428,6 +428,15 @@ class ReplicaFleet:
     surface. Call :meth:`shutdown` when done — it releases every
     replica's KV pool/arena, the standby pool, and the router.
 
+    ``backend="process"`` constructs the process-backed variant
+    (:class:`~ray_lightning_tpu.serve.process_fleet.
+    ProcessReplicaFleet`, same contract and ``isinstance`` identity):
+    each replica dispatches in its own worker process, so N replicas
+    actually deliver ~N× tokens/sec instead of time-slicing this
+    class's single drive thread. The default ``"inproc"`` backend
+    stays the deterministic tick-clock harness every pinned trace and
+    chaos test replays against.
+
     Failure semantics: a replica that crashes (its dispatch raises —
     including ``serve.replica`` ``raise`` faults) or hangs (stops
     completing dispatch turns past ``heartbeat_timeout``) is torn down
@@ -440,13 +449,31 @@ class ReplicaFleet:
     only sees whole-replica deaths.
     """
 
-    def __init__(self, model, params, *, num_replicas: int = 2,
+    def __new__(cls, *args: Any, **kwargs: Any) -> "ReplicaFleet":
+        # the backend switch: ``ReplicaFleet(..., backend="process")``
+        # constructs a ProcessReplicaFleet (same contract, replicas in
+        # their own worker processes — see serve/process_fleet.py).
+        # Dispatched here so callers hold ONE fleet type and
+        # ``isinstance(fleet, ReplicaFleet)`` stays true either way.
+        backend = kwargs.get("backend", "inproc")
+        if backend not in ("inproc", "process"):
+            raise ValueError(
+                f"backend must be 'inproc' or 'process', got {backend!r}")
+        if cls is ReplicaFleet and backend == "process":
+            from ray_lightning_tpu.serve.process_fleet import \
+                ProcessReplicaFleet
+            return object.__new__(ProcessReplicaFleet)
+        return object.__new__(cls)
+
+    def __init__(self, model, params, *, backend: str = "inproc",
+                 num_replicas: int = 2,
                  num_standby: int = 0,
                  fleet_config: Optional[FleetConfig] = None,
                  router_config: Optional[RouterConfig] = None,
                  telemetry: Any = None,
                  clock: Optional[Callable[[], float]] = None,
                  **engine_kwargs: Any):
+        self.backend = "inproc"
         if num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {num_replicas}")

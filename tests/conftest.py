@@ -110,6 +110,15 @@ def pytest_configure(config):
         "(TenantClass tiers/weights/quotas, deficit-weighted fair "
         "share, class-aware admission control, per-tenant obs) — "
         "`pytest -m tenancy` runs it as a fast targeted subset")
+    config.addinivalue_line(
+        "markers", "fleet_process: the process-backend replica fleet "
+        "(ReplicaFleet(backend='process'): one dispatch process per "
+        "replica, queue-transport results, heartbeat-channel clock) — "
+        "`pytest -m fleet_process` runs it as a targeted subset")
+    config.addinivalue_line(
+        "markers", "slow: heavy multi-process / wall-clock cases "
+        "excluded from the tier-1 gate (`-m 'not slow'`); run them "
+        "with `pytest -m slow`")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,349 @@
+"""Process-backend replica fleet: real worker processes, same contract.
+
+The load-bearing assertions mirror ``tests/test_fleet.py``'s in-process
+pins, transplanted across a genuine process boundary:
+
+- **kill -9 failover token identity** — a replica hard-killed
+  mid-decode must retire every request ``finish_reason != "failed"``
+  with outputs token-for-token identical to an uninterrupted
+  single-engine run, re-admitted from the driver's progress ledger
+  (there is no snapshot RPC to call on a corpse);
+- **death classification** — the ``_dead`` latch is consulted FIRST
+  (the PR 11 ``actor_alive`` rule), so the kill reports
+  ``replica.dead``, never ``replica.error``, even when the first
+  symptom was a failed RPC;
+- **hang verdicts ride the heartbeat channel** — a wedged dispatch
+  loop stops beating and is failed over as ``dead=False`` in bounded
+  wall time;
+- **tenancy classes survive re-admission**.
+
+Everything spawning processes is marked ``multiproc``; the heavy
+chaos cases are additionally ``slow`` (excluded from the tier-1
+``-m 'not slow'`` gate) — one smoke spawn stays tier-1 so the backend
+switch itself is always exercised.
+"""
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.obs import Telemetry
+from ray_lightning_tpu.serve import (ProcessReplicaFleet, ReplicaFleet,
+                                     Request, Router, ServeClient,
+                                     TenantClass)
+from ray_lightning_tpu.serve.process_fleet import (_classify_failure,
+                                                   _ProcessReplica)
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet_process]
+
+
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+def _ref(dec, params, reqs, **kw):
+    """Uninterrupted single-engine reference, sized to admit everything."""
+    kw.setdefault("num_slots", 8)
+    kw.setdefault("prefill_len", 16)
+    client = ServeClient(dec, params, **kw)
+    out = client.serve_trace([(0, kw_) for kw_ in reqs])
+    client.shutdown()
+    return out
+
+
+# --------------------------------------------------------------------- #
+# fast (no process spawn): switch, classification, router mirrors
+# --------------------------------------------------------------------- #
+def test_backend_switch_validates_and_dispatches(nano):
+    dec, params = nano
+    with pytest.raises(ValueError, match="backend must be"):
+        ReplicaFleet(None, None, backend="threads")
+    # the process backend is wall-clock by construction — rejected
+    # before anything spawns
+    with pytest.raises(ValueError, match="wall-clock only"):
+        ReplicaFleet(None, None, backend="process", clock=time.monotonic)
+    fleet = ReplicaFleet(dec, params, num_replicas=1, num_slots=2,
+                         prefill_len=8)
+    try:
+        assert type(fleet) is ReplicaFleet
+        assert fleet.backend == "inproc"
+    finally:
+        fleet.shutdown()
+
+
+class _FakeProc:
+    def __init__(self, alive):
+        self._alive = alive
+
+    def is_alive(self):
+        return self._alive
+
+
+class _FakeHandle:
+    def __init__(self, dead, proc_alive, killed=False):
+        self._dead = dead
+        self._proc = _FakeProc(proc_alive)
+        self._killed = killed
+
+
+def test_classify_failure_consults_dead_latch_first():
+    """The satellite fix: a hard-killed replica whose first symptom was
+    a dispatch error (MSG_CRASH raced the pipe EOF, or is_alive() still
+    reads True in the waitpid teardown window) must classify "dead" —
+    the ``_dead`` latch wins over both the crash flag and the process
+    probe, same as the PR 11 gang-side ``worker.dead`` rule."""
+    assert _classify_failure(_FakeHandle(True, True, killed=False),
+                             crashed=True) == "dead"
+    assert _classify_failure(_FakeHandle(True, True), crashed=False) \
+        == "dead"
+    assert _classify_failure(_FakeHandle(False, False),
+                             crashed=False) == "dead"
+    assert _classify_failure(_FakeHandle(False, True),
+                             crashed=True) == "error"
+    assert _classify_failure(_FakeHandle(False, True),
+                             crashed=False) == "hung"
+
+
+def _seat(rid, **stats):
+    rep = _ProcessReplica(rid, object(), {"max_replay_len": 64,
+                                          "tenancy": False})
+    rep.apply_stats(stats)
+    return rep
+
+
+def test_router_scores_status_mirrors_like_live_objects():
+    """The unmodified in-process Router ranks process-backend mirror
+    seats exactly as it would rank live clients: load (queue + active +
+    chunking), then per-class depth, then paged occupancy, id tiebreak
+    last."""
+    router = Router()
+    r0 = _seat(0, queue_depth=2, active=1)             # load 3
+    r1 = _seat(1, active=1)                            # load 1
+    r2 = _seat(2, active=1, class_depths={"fast": 2})  # load 1, class 2
+    req = Request(id=0, prompt=[1, 2], max_new_tokens=2, tenant="fast")
+    assert [r.id for r in router.order([r0, r1, r2], req)] == [1, 2, 0]
+    # untenanted mirrors report {} — class_load scores 0, identical to
+    # the pre-tenancy order (the A/B contract, across the boundary)
+    assert Router.class_load(r1, req) == 0
+    assert Router.class_load(r2, req) == 2
+    # paged occupancy tiebreak comes straight off the status mirror
+    r3 = _seat(3, active=1, free_pages=1, num_pages=4)  # 0.75 occupied
+    r4 = _seat(4, active=1, free_pages=3, num_pages=4)  # 0.25 occupied
+    untenanted = Request(id=1, prompt=[1], max_new_tokens=2)
+    assert [r.id for r in router.order([r3, r4], untenanted)] == [4, 3]
+    assert not _seat(5).busy
+    assert _seat(6, chunk_pending=1).busy
+
+
+# --------------------------------------------------------------------- #
+# tier-1 smoke: one real 2-process fleet, token identity, clean teardown
+# --------------------------------------------------------------------- #
+TRACE = [
+    (0.0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+    (0.0, dict(prompt=[9, 2, 44], max_new_tokens=6)),
+    (0.2, dict(prompt=[42, 7], max_new_tokens=5)),
+    (0.3, dict(prompt=[1], max_new_tokens=6)),
+]
+
+
+@pytest.mark.multiproc
+def test_process_fleet_smoke_token_identity(nano):
+    """N=2 real worker processes serve a staggered wall-clock trace and
+    emit exactly the single-engine tokens; shutdown leaves zero live
+    actor processes."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, backend="process", num_replicas=2,
+                         num_slots=4, prefill_len=16, telemetry=tel)
+    assert isinstance(fleet, ReplicaFleet)
+    assert type(fleet) is ProcessReplicaFleet
+    assert fleet.backend == "process"
+    try:
+        out = fleet.serve_trace(TRACE)
+    finally:
+        backend = fleet.process_backend
+        fleet.shutdown()
+    ref = _ref(dec, params, [kw for _, kw in TRACE])
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].finish_reason == ref[rid].finish_reason, rid
+        assert out[rid].time_to_first_token is not None, rid
+    # the two t=0 arrivals spread across both replicas, id tiebreak
+    routes = [e.payload["replica"] for e in tel.events("fleet.route")]
+    assert routes[:2] == [0, 1]
+    # worker-side serve events forwarded over the queue transport
+    assert tel.events("serve.submit")
+    assert tel.events("serve.retire")
+    # per-replica dispatch turns rode the heartbeat channel
+    assert all(s > 0 for s in fleet.replica_steps.values())
+    assert fleet.replicas_live == 0
+    assert backend.live_actor_count() == 0
+
+
+# --------------------------------------------------------------------- #
+# slow chaos: kill -9 failover, hang verdict, tenancy preservation
+# --------------------------------------------------------------------- #
+LONG_REQS = [
+    dict(prompt=[5, 17, 3, 9], max_new_tokens=20),
+    dict(prompt=[9, 2, 44], max_new_tokens=20),
+    dict(prompt=[42, 7], max_new_tokens=18),
+    dict(prompt=[1, 33, 2], max_new_tokens=20),
+]
+
+# prefill_len sizes the unchunked replay window (prompt + emitted must
+# re-feed through ONE prefill on the survivor): worst case here is a
+# 4-token prompt with 19 flushed tokens at kill time — nano decodes
+# faster than the driver's poll quantum, so the kill can land late
+ENGINE = dict(num_slots=2, prefill_len=32, steps_per_dispatch=2)
+
+
+def _pump_until(fleet, cond, timeout_s=90.0, msg=""):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        fleet.tick()
+        if cond():
+            return
+        time.sleep(0.01)  # tl-lint: allow-sleep — wall-clock poll against real worker processes
+    raise AssertionError(f"condition not reached in {timeout_s}s: {msg}")
+
+
+@pytest.mark.multiproc
+@pytest.mark.slow
+def test_process_fleet_kill9_failover_token_identity(nano):
+    """kill -9 a replica mid-decode: its requests re-admit to the
+    survivor from the driver-side progress ledger, finish
+    ``finish_reason != "failed"`` with single-engine-identical tokens;
+    the death classifies ``replica.dead`` (latch-first) and the warm
+    standby is promoted to restore capacity."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, backend="process", num_replicas=2,
+                         num_standby=1, telemetry=tel, **ENGINE)
+    try:
+        for kw in LONG_REQS:
+            fleet.submit(**kw)
+        victim = fleet._replicas[0]
+        _pump_until(
+            fleet,
+            lambda: any(t.replica == victim.id and t.tokens
+                        for t in fleet._inflight.values()),
+            msg="victim never flushed decode progress")
+        os.kill(victim.actor._proc.pid, signal.SIGKILL)
+        out = fleet.run_until_idle()
+        _pump_until(fleet, lambda: fleet.replicas_live == 2,
+                    msg="capacity never restored after failover")
+    finally:
+        backend = fleet.process_backend
+        fleet.shutdown()
+    ref = _ref(dec, params, LONG_REQS, **{**ENGINE, "num_slots": 8})
+    for rid in ref:
+        assert out[rid].finish_reason != "failed", rid
+        assert out[rid].tokens == ref[rid].tokens, rid
+    assert fleet.failovers == 1
+    assert fleet.readmitted >= 1
+    # latch-first classification: dead, never a dispatch error
+    assert tel.events("replica.dead")
+    assert not tel.events("replica.error")
+    fo = tel.events("fleet.failover")
+    assert len(fo) == 1 and fo[0].payload["dead"] is True
+    assert tel.events("recovery.replay")
+    promoted = tel.events("fleet.replica_promoted")
+    assert promoted and promoted[0].payload["source"] == "standby"
+    assert backend.live_actor_count() == 0
+
+
+@pytest.mark.multiproc
+@pytest.mark.slow
+def test_process_fleet_hang_verdict_via_heartbeat_channel(nano):
+    """A live-but-wedged replica stops beating on the heartbeat channel
+    and is failed over as hung (``fleet.failover`` with ``dead=False``)
+    within the configured timeout; its work still finishes elsewhere,
+    token-identical."""
+    from ray_lightning_tpu.serve import FleetConfig
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, backend="process", num_replicas=2,
+                         telemetry=tel,
+                         fleet_config=FleetConfig(heartbeat_timeout=1.5,
+                                                  startup_grace=60.0),
+                         **ENGINE)
+    try:
+        for kw in LONG_REQS[:2]:
+            fleet.submit(**kw)
+        victim = fleet._replicas[0]
+        # let the victim dispatch at least once (its step beats end the
+        # startup grace; the timeout clock applies after)
+        _pump_until(fleet, lambda: victim.last_step >= 1,
+                    msg="victim never completed a dispatch turn")
+        fleet._ray.get(victim.actor.inject.remote("stall"), timeout=30)
+        _pump_until(fleet, lambda: fleet.failovers == 1,
+                    msg="hang verdict never fired")
+        out = fleet.run_until_idle()
+    finally:
+        fleet.shutdown()
+    ref = _ref(dec, params, LONG_REQS[:2], **{**ENGINE, "num_slots": 8})
+    for rid in ref:
+        assert out[rid].finish_reason != "failed", rid
+        assert out[rid].tokens == ref[rid].tokens, rid
+    fo = tel.events("fleet.failover")
+    assert len(fo) == 1 and fo[0].payload["dead"] is False
+    assert not tel.events("replica.dead")
+
+
+CLASSES = [
+    TenantClass("fast", weight=4.0, tier="interactive", ttft_slo=6.0),
+    TenantClass("bulk", weight=1.0, tier="batch"),
+]
+
+TENANT_REQS = [
+    dict(prompt=[11, 12], max_new_tokens=16, tenant="bulk"),
+    dict(prompt=[15, 3], max_new_tokens=16, tenant="fast"),
+    dict(prompt=[13, 14, 9], max_new_tokens=14, tenant="bulk"),
+    dict(prompt=[16, 8], max_new_tokens=14, tenant="fast"),
+]
+
+
+@pytest.mark.multiproc
+@pytest.mark.slow
+@pytest.mark.tenancy
+def test_process_fleet_failover_preserves_tenant_class(nano):
+    """Tenancy armed across the process boundary: the kill -9 victim's
+    requests re-admit with their tenant class intact (completions carry
+    it, and the forwarded per-class admission events name it)."""
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, backend="process", num_replicas=2,
+                         telemetry=tel, tenant_classes=CLASSES, **ENGINE)
+    try:
+        rids = {fleet.submit(**kw): kw["tenant"] for kw in TENANT_REQS}
+        victim = fleet._replicas[0]
+        assert victim.info["tenancy"] is True
+        _pump_until(
+            fleet,
+            lambda: any(t.replica == victim.id and t.tokens
+                        for t in fleet._inflight.values()),
+            msg="victim never flushed decode progress")
+        os.kill(victim.actor._proc.pid, signal.SIGKILL)
+        out = fleet.run_until_idle()
+    finally:
+        fleet.shutdown()
+    ref = _ref(dec, params, TENANT_REQS,
+               **{**ENGINE, "num_slots": 8, "tenant_classes": CLASSES})
+    for rid, tenant in rids.items():
+        assert out[rid].finish_reason != "failed", rid
+        assert out[rid].tenant == tenant, rid
+        assert out[rid].tokens == ref[rid].tokens, rid
+    admitted = tel.events("engine.tenant_admitted")
+    assert {e.payload["tenant"] for e in admitted} >= {"fast", "bulk"}
+    assert tel.events("replica.dead")

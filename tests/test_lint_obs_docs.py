@@ -68,6 +68,14 @@ def test_event_names_discovered():
     assert "retry.attempt" in EVENTS         # literal via tel.bus.emit
     assert "worker.dead" in EVENTS           # EVENT_* constant
     assert "engine.tenant_admitted" in EVENTS
+    # PR 16 process-fleet verdicts: constants in serve/process_fleet.py
+    # (the _dead-latch-first classification's documented faces). Events
+    # a worker process forwards over the queue transport re-emit
+    # driver-side through tel.event — same names the worker's
+    # ServeClient already emits, so the collection above covers them;
+    # these two are the only NEW names the process backend adds.
+    assert "replica.dead" in EVENTS
+    assert "replica.error" in EVENTS
     assert len(EVENTS) >= 40
 
 
