@@ -10,6 +10,10 @@ requests hand their KV slot to the next one without any recompilation.
     python examples/serve_example.py --num-slots 4 --requests 12
     python examples/serve_example.py --fleet-replicas 2 \
         --fleet-backend process   # one dispatch process per replica
+    python examples/serve_example.py --adapter tuned=/path/to/publish \
+        --tenant-classes 'fast:interactive@tuned,bulk:batch'
+        # batched multi-LoRA: adapter rows + base rows in one dispatch,
+        # class 'fast' bound to the adapter with no per-request flag
 
 The same trace is replayed as a static batch (one-shot ``generate()``
 that must wait for the LAST arrival before starting) so the makespan
@@ -89,10 +93,26 @@ def main():
                              "arena, the per-dispatch param stream is "
                              "the codes+scales floor (interpret mode "
                              "off-TPU; tokens identical either way).")
+    parser.add_argument("--adapter", action="append", default=[],
+                        metavar="NAME=PATH",
+                        help="hot-serve a published LoRA adapter "
+                             "(repeatable): NAME binds requests, PATH "
+                             "is a checkpoint directory written by "
+                             "extract_adapter + save_sharded_checkpoint "
+                             "(e.g. examples/lora_lifecycle_example.py "
+                             "--publish-dir). Adapters are assigned "
+                             "round-robin across the trace (every "
+                             "cycle keeps one base row), rows with "
+                             "different adapters batch in the SAME "
+                             "dispatches, and each adapter-bound "
+                             "greedy row is verified token-identical "
+                             "to a solo single-adapter engine "
+                             "(docs/serving.md#multi-lora-serving).")
     parser.add_argument("--tenant-classes", default=None,
                         help="arm multi-tenant SLO-aware scheduling: "
-                             "comma-separated 'name:tier[:weight]' "
-                             "entries, tier in {interactive,batch} "
+                             "comma-separated 'name:tier[:weight][@"
+                             "adapter]' entries, tier in "
+                             "{interactive,batch} "
                              "(e.g. 'fast:interactive:4,bulk:batch:1' "
                              "— interactive drains first, weights set "
                              "fair share within a tier, batch is "
@@ -107,7 +127,12 @@ def main():
                              "round-robin across the trace (needs "
                              "--tenant-classes; default: cycle every "
                              "declared class, a mixed "
-                             "interactive+batch trace).")
+                             "interactive+batch trace). A trailing "
+                             "'@adapter' on a class binds that LoRA "
+                             "as the class default (needs --adapter "
+                             "NAME=PATH): the class's rows decode "
+                             "under it with no per-request adapter= "
+                             "at all — the tenant-to-adapter binding.")
     parser.add_argument("--fleet-replicas", type=int, default=0,
                         help="serve the trace through an N-replica "
                              "ReplicaFleet instead of one ServeClient "
@@ -133,20 +158,35 @@ def main():
     if args.tenant is not None and args.tenant_classes is None:
         parser.error("--tenant needs --tenant-classes (it names "
                      "classes that flag declares)")
+    adapter_specs = {}
+    for spec in args.adapter:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            parser.error(f"bad --adapter entry {spec!r}: expected "
+                         "NAME=PATH")
+        if name in adapter_specs:
+            parser.error(f"duplicate --adapter name {name!r}")
+        adapter_specs[name] = path
     tenant_classes = None
     tenant_cycle = []
     if args.tenant_classes is not None:
         from ray_lightning_tpu.serve import TenantClass
         tenant_classes = []
         for spec in args.tenant_classes.split(","):
-            parts = spec.strip().split(":")
+            spec, _, bound = spec.strip().partition("@")
+            parts = spec.split(":")
             if len(parts) not in (2, 3):
                 parser.error(f"bad --tenant-classes entry {spec!r}: "
-                             "expected name:tier[:weight]")
+                             "expected name:tier[:weight][@adapter]")
+            if bound and bound not in adapter_specs:
+                parser.error(f"--tenant-classes binds adapter "
+                             f"{bound!r} which no --adapter NAME=PATH "
+                             "declares")
             try:
                 tenant_classes.append(TenantClass(
                     parts[0], tier=parts[1],
-                    weight=float(parts[2]) if len(parts) == 3 else 1.0))
+                    weight=float(parts[2]) if len(parts) == 3 else 1.0,
+                    adapter=bound or None))
             except ValueError as exc:
                 parser.error(f"bad --tenant-classes entry {spec!r}: "
                              f"{exc}")
@@ -180,6 +220,34 @@ def main():
     dec = TransformerLM(dec_cfg)
     params = unstack_scan_params(params)
 
+    # published LoRA adapters: load each NAME=PATH checkpoint (the
+    # lifecycle example's publish format — meta carries the rank, the
+    # state is the extract_adapter tree) and arm a resident bank sized
+    # to hold them all. One bank, one set of compiled programs: rows
+    # bound to different adapters batch in the same dispatches.
+    adapters = {}
+    lora_rank = None
+    if adapter_specs:
+        from ray_lightning_tpu.core.checkpoint import \
+            load_sharded_checkpoint
+        for name, path in adapter_specs.items():
+            ckpt = load_sharded_checkpoint(path)
+            adapters[name] = ckpt["state"]
+            rank = ckpt.get("lora_rank")
+            if rank is None:  # older publishes: read it off a slice
+                rank = next(
+                    int(leaf.shape[-1]) for p, leaf
+                    in jax.tree_util.tree_leaves_with_path(ckpt["state"])
+                    if jax.tree_util.keystr(p).endswith("lora_A']"))
+            if lora_rank not in (None, rank):
+                parser.error(f"adapter {name!r} has rank {rank} but an "
+                             f"earlier one has {lora_rank}: one bank "
+                             "holds one rank")
+            lora_rank = rank
+        print(f"serving {len(adapters)} LoRA adapter(s) "
+              f"{sorted(adapters)} (rank {lora_rank}) from one "
+              "resident bank")
+
     # 3) a deterministic staggered trace: ragged prompts, mixed budgets
     #    and sampling params (greedy rows are verified against generate())
     rng = np.random.default_rng(0)
@@ -195,6 +263,22 @@ def main():
             # round-robin class assignment: a mixed interactive+batch
             # trace by default, or whatever cycle --tenant names
             kw["tenant"] = tenant_cycle[i % len(tenant_cycle)]
+        if adapters:
+            # rows whose tenant class binds a default adapter carry no
+            # adapter= at all — the engine resolves the class default
+            # at admission (the tenant-to-adapter binding); everything
+            # else cycles [base, *adapters] explicitly so every batch
+            # mixes adapted and base rows
+            bound = {c.name for c in (tenant_classes or [])
+                     if c.adapter is not None}
+            if kw.get("tenant") not in bound:
+                # i//2 keeps the cycle out of phase with the
+                # greedy/sampled alternation: each adapter (and the
+                # base) gets one greedy AND one sampled row per cycle
+                acycle = [None] + sorted(adapters)
+                name = acycle[(i // 2) % len(acycle)]
+                if name is not None:
+                    kw["adapter"] = name
         trace.append((i * args.gap, kw))
 
     # --attention-kernel selects the page-native read-side kernel; the
@@ -214,6 +298,9 @@ def main():
         weight_group_size=args.weight_group_size,
         matmul_kernel=args.matmul_kernel, **paged_kw,
         tenant_classes=tenant_classes,
+        **(dict(adapters=adapters,
+                max_resident_adapters=len(adapters),
+                lora_rank=lora_rank) if adapters else {}),
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))
     unit, ufmt = "ticks", ".0f"
@@ -249,10 +336,11 @@ def main():
     for rid in sorted(out):
         c = out[rid]
         cls = f" [{c.tenant}]" if tenant_classes else ""
+        ad = f" <{c.adapter}>" if c.adapter else ""
         print(f"  req {rid:2d}: prompt {len(c.prompt):2d} toks -> "
               f"{len(c.tokens):2d} generated ({c.finish_reason}), "
               f"latency {c.latency:{ufmt}} {unit}, "
-              f"ttft {c.time_to_first_token:{ufmt}} {unit}{cls}")
+              f"ttft {c.time_to_first_token:{ufmt}} {unit}{cls}{ad}")
 
     if tenant_classes:
         # per-class rollup: interactive classes should show the lower
@@ -266,10 +354,42 @@ def main():
             print(f"  {cls.name:>8s} ({cls.tier}, w={cls.weight:g}): "
                   f"{len(comps):2d} served, mean ttft {mean:.1f} {unit}")
 
-    # 4) verify greedy rows against one-shot generate(), and show what
-    #    the static batch costs: it cannot start before the LAST arrival.
-    #    (Quantized weights perturb logits by design — the identity
-    #    check only holds at full precision; see docs/serving.md.)
+    # 4a) the multi-LoRA identity contract, driven end to end: every
+    #     adapter-bound greedy row in the MIXED batch must be
+    #     token-identical to a solo engine holding only that adapter
+    #     (same bank capacity, so the compiled programs are shared).
+    #     Holds under quantization too — the LoRA delta rides outside
+    #     the quantized base matmul.
+    if adapters:
+        groups = {}
+        for i in range(len(trace)):
+            if trace[i][1]["temperature"] == 0.0 and out[i].adapter:
+                groups.setdefault(out[i].adapter, []).append(i)
+        solo_kw = dict(engine_kw)
+        solo_kw.pop("tenant_classes", None)
+        mism = 0
+        for name, rids in sorted(groups.items()):
+            solo_kw["adapters"] = {name: adapters[name]}
+            solo = ServeClient(dec, params, **solo_kw)
+            sids = [solo.submit(trace[rid][1]["prompt"],
+                                max_new_tokens=args.max_new,
+                                adapter=name) for rid in rids]
+            comps = solo.run_until_idle()
+            solo.shutdown()
+            mism += sum(out[rid].tokens != comps[sid].tokens
+                        for rid, sid in zip(rids, sids))
+        n = sum(len(v) for v in groups.values())
+        print(f"\nadapter-bound greedy rows token-identical to solo "
+              f"single-adapter engines: {mism == 0} ({n} rows)")
+        if mism:
+            raise SystemExit("mixed-adapter batch diverged from solo "
+                             "engines")
+
+    # 4b) verify base greedy rows against one-shot generate(), and show
+    #    what the static batch costs: it cannot start before the LAST
+    #    arrival. (Quantized weights perturb logits by design — the
+    #    identity check only holds at full precision; see
+    #    docs/serving.md.)
     if args.weight_dtype is not None:
         print("\nweight_dtype set: skipping the full-precision "
               "generate() identity check (quantization perturbs "
@@ -277,7 +397,11 @@ def main():
               "quantized contract)")
         return
     greedy_ids = [i for i, (_, kw) in enumerate(trace)
-                  if kw["temperature"] == 0.0]
+                  if kw["temperature"] == 0.0 and out[i].adapter is None]
+    if not greedy_ids:
+        print("\nno base greedy rows in this trace: skipping the "
+              "generate() identity check")
+        return
     prompts = [trace[i][1]["prompt"] for i in greedy_ids]
     P = max(len(p) for p in prompts)
     batch = np.zeros((len(prompts), P), np.int32)

@@ -19,6 +19,9 @@ from ray_lightning_tpu.models.vit import (ViTClassifier, ViTModule,
                                           vit_config)
 from ray_lightning_tpu.models.seq2seq import (Seq2SeqModule,
                                               Seq2SeqTransformer)
+from ray_lightning_tpu.models.lora import (LoraConfig, adapter_bytes,
+                                           extract_adapter, install_adapter,
+                                           install_lora_bank, zero_adapter)
 from ray_lightning_tpu.models.generate import (decode_step, generate,
                                                generate_full_scan, prefill,
                                                sample_logits,
@@ -35,5 +38,7 @@ __all__ = [
     "decode_step", "generate", "generate_full_scan", "prefill",
     "sample_logits", "sample_logits_rows", "latch_eos",
     "tensor_parallel_rule",
-    "Seq2SeqModule", "Seq2SeqTransformer"
+    "Seq2SeqModule", "Seq2SeqTransformer",
+    "LoraConfig", "adapter_bytes", "extract_adapter", "install_adapter",
+    "install_lora_bank", "zero_adapter",
 ]

@@ -175,8 +175,16 @@ def _row_update(rows: jax.Array, vals: jax.Array,
             rows, vals, starts)
 
 
+def _adapter_kw(adapter_ids):
+    """Kwargs guard for the per-row LoRA adapter ids: ``None`` adds
+    nothing (model families without the kwarg — MoE, encoders — and
+    unadapted engines never see it, and None-vs-array are different
+    pytree structures so unadapted programs never recompile)."""
+    return {} if adapter_ids is None else {"adapter_ids": adapter_ids}
+
+
 def decode_step(model, params, cache, tokens: jax.Array,
-                kv_positions: jax.Array):
+                kv_positions: jax.Array, adapter_ids=None):
     """ONE cached single-token decode step at explicit per-row positions —
     the shared core between :func:`generate`'s ragged decode scan and the
     serving engine's continuous-batching step
@@ -205,11 +213,13 @@ def decode_step(model, params, cache, tokens: jax.Array,
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, tokens,
         positions=kv_positions, kv_positions=kv_positions,
-        deterministic=True, mutable=["cache"])
+        deterministic=True, mutable=["cache"],
+        **_adapter_kw(adapter_ids))
     return _logits_only(outputs)[:, -1], updated["cache"]
 
 
-def _arena_apply(model, params, arena, tokens, kv_positions, page_table):
+def _arena_apply(model, params, arena, tokens, kv_positions, page_table,
+                 adapter_ids=None):
     """Shared page-native ``model.apply`` plumbing: the arena's cache
     tree rides as the ``cache`` collection (int8 arenas split their
     ``(codes, scales)`` tuple across ``cache`` + ``kvscale``), and the
@@ -225,14 +235,16 @@ def _arena_apply(model, params, arena, tokens, kv_positions, page_table):
     outputs, updated = model.apply(
         variables, tokens, positions=kv_positions,
         kv_positions=kv_positions, page_table=page_table,
-        deterministic=True, mutable=mutable)
+        deterministic=True, mutable=mutable,
+        **_adapter_kw(adapter_ids))
     new_arena = ((updated["cache"], updated["kvscale"]) if quantized
                  else updated["cache"])
     return _logits_only(outputs), new_arena
 
 
 def decode_step_paged(model, params, arena, tokens: jax.Array,
-                      kv_positions: jax.Array, page_table: jax.Array):
+                      kv_positions: jax.Array, page_table: jax.Array,
+                      adapter_ids=None):
     """Page-native sibling of :func:`decode_step`: ONE cached
     single-token step whose K/V reads and writes go straight through
     the serving engine's page arena — no dense per-slot view is
@@ -247,23 +259,24 @@ def decode_step_paged(model, params, arena, tokens: jax.Array,
     """
     params = materialize_for_program(params, model.cfg)
     logits, arena = _arena_apply(model, params, arena, tokens,
-                                 kv_positions, page_table)
+                                 kv_positions, page_table, adapter_ids)
     return logits[:, -1], arena
 
 
 def verify_step_paged(model, params, arena, tokens: jax.Array,
-                      kv_positions: jax.Array, page_table: jax.Array):
+                      kv_positions: jax.Array, page_table: jax.Array,
+                      adapter_ids=None):
     """Page-native sibling of :func:`verify_step`: the speculative
     verify's per-row (B, T) block scoring, reading/writing K/V through
     the page table. Returns ``(logits (B, T, V), arena)`` — every
     offset's logits, as the accept rule requires."""
     params = materialize_for_program(params, model.cfg)
     return _arena_apply(model, params, arena, tokens, kv_positions,
-                        page_table)
+                        page_table, adapter_ids)
 
 
 def verify_step(model, params, cache, tokens: jax.Array,
-                kv_positions: jax.Array):
+                kv_positions: jax.Array, adapter_ids=None):
     """ONE cached block-scoring step at per-row positions — the target
     side of speculative decoding (:mod:`ray_lightning_tpu.serve.spec`).
 
@@ -288,11 +301,13 @@ def verify_step(model, params, cache, tokens: jax.Array,
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, tokens,
         positions=kv_positions, kv_positions=kv_positions,
-        deterministic=True, mutable=["cache"])
+        deterministic=True, mutable=["cache"],
+        **_adapter_kw(adapter_ids))
     return _logits_only(outputs), updated["cache"]
 
 
-def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
+def _prefill_impl(model, params, prompt_tokens, prompt_lengths,
+                  adapter_ids=None):
     params = materialize_for_program(params, model.cfg)
     B, P = prompt_tokens.shape
     prompt_tokens = prompt_tokens.astype(jnp.int32)
@@ -302,7 +317,8 @@ def _prefill_impl(model, params, prompt_tokens, prompt_lengths):
     positions = jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
     outputs, updated = model.apply(
         {"params": params, "cache": cache}, prompt_tokens,
-        positions=positions, deterministic=True, mutable=["cache"])
+        positions=positions, deterministic=True, mutable=["cache"],
+        **_adapter_kw(adapter_ids))
     logits = _logits_only(outputs)
     if prompt_lengths is None:
         last = logits[:, -1]

@@ -258,8 +258,13 @@ def quantize_params(params, weight_dtype: str = "int8",
             if path else ""
         # biases stay full precision even when ndim >= 2 (the
         # DenseGeneral qkv bias is (3, H, Dh)): O(d) of the stream,
-        # disproportionately precision-load-bearing
-        if (name == "bias" or not hasattr(leaf, "ndim") or leaf.ndim < 2
+        # disproportionately precision-load-bearing. LoRA adapter
+        # banks (models/lora.py) stay full precision too: rank-r
+        # deltas are O(r*d) of the stream and hot load/unload writes
+        # per-slot slices in place — quantized codes would round every
+        # co-resident adapter on each install
+        if (name == "bias" or name.startswith("lora_")
+                or not hasattr(leaf, "ndim") or leaf.ndim < 2
                 or not jnp.issubdtype(leaf.dtype, jnp.floating)):
             return leaf
         if weight_dtype == "int8":

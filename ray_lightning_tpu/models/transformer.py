@@ -92,6 +92,17 @@ class TransformerConfig:
     attention_softmax_dtype: Any = jnp.float32
     tie_embeddings: bool = True
     num_segments: int = 0            # >0 adds segment embeddings (BERT)
+    # multi-LoRA arming (models/lora.py LoraConfig, hashable; None =
+    # stock model, byte-for-byte the pre-LoRA family): targeted
+    # projections swap for their bank-delegating siblings and every
+    # *Block call accepts per-row ``adapter_ids`` — each batch row
+    # gathers its own (A, B) pair from a resident
+    # (num_adapters, r, d) bank and adds the low-rank delta OUTSIDE
+    # the (possibly quantized) base matmul. Selected via
+    # ServeEngine/ServeClient(adapters=, max_resident_adapters=,
+    # lora_rank=) on the serve side; trained directly by building the
+    # model with lora=LoraConfig(rank, num_adapters=1).
+    lora: Any = None
 
     def __post_init__(self):
         if self.scan_unroll < 1:
@@ -123,6 +134,12 @@ class TransformerConfig:
                 raise ValueError(
                     f"remat_policy must be one of {valid} or None, got "
                     f"{self.remat_policy!r}")
+        if self.lora is not None:
+            from ray_lightning_tpu.models.lora import LoraConfig
+            if not isinstance(self.lora, LoraConfig):
+                raise ValueError(
+                    f"lora must be a models.lora.LoraConfig or None, "
+                    f"got {type(self.lora).__name__}")
 
     @property
     def head_dim(self) -> int:
@@ -318,6 +335,25 @@ class QuantEmbed(nn.Module):
                              self.matmul_kernel, transpose=True)
 
 
+def _projection(cfg: TransformerConfig, *, features, in_features: int,
+                name: str, dense: bool = False):
+    """One named block projection as a call closure ``f(x, adapter_ids)``:
+    the stock quant layer (adapter_ids ignored — the module graph is
+    byte-for-byte the pre-LoRA family), or its bank-delegating LoRA
+    sibling when ``cfg.lora`` targets this name (models/lora.py)."""
+    if cfg.lora is not None and name in cfg.lora.targets:
+        from ray_lightning_tpu.models.lora import LoraDense, LoraDenseGeneral
+        cls = LoraDense if dense else LoraDenseGeneral
+        mod = cls(features=features, in_features=in_features,
+                  lora=cfg.lora, matmul_kernel=cfg.matmul_kernel,
+                  dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+        return lambda x, adapter_ids: mod(x, adapter_ids)
+    cls = QuantDense if dense else QuantDenseGeneral
+    mod = cls(features=features, matmul_kernel=cfg.matmul_kernel,
+              dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+    return lambda x, adapter_ids: mod(x)
+
+
 def _attention_fn(cfg: TransformerConfig):
     if cfg.attention_impl == "dot":
         return dot_product_attention
@@ -339,13 +375,12 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True, kv_positions=None,
-                 page_table=None):
+                 page_table=None, adapter_ids=None):
         cfg = self.cfg
         B, T, _ = x.shape
-        qkv = QuantDenseGeneral(
-            features=(3, cfg.n_heads, cfg.head_dim),
-            matmul_kernel=cfg.matmul_kernel,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="qkv")(x)
+        qkv = _projection(
+            cfg, features=(3, cfg.n_heads, cfg.head_dim),
+            in_features=cfg.d_model, name="qkv")(x, adapter_ids)
         # static index slices, not moveaxis: the 3-to-front transpose
         # materializes a layout-changing copy of the whole qkv tensor on
         # TPU (376us/step at GPT-2-small bs8 in the v5e trace); slices
@@ -360,10 +395,10 @@ class MultiHeadAttention(nn.Module):
             from jax.ad_checkpoint import checkpoint_name
             out = checkpoint_name(out, "attn_out")
             out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
-            return QuantDenseGeneral(
-                features=cfg.d_model, matmul_kernel=cfg.matmul_kernel,
-                dtype=cfg.dtype,
-                param_dtype=cfg.param_dtype, name="out")(out)
+            return _projection(
+                cfg, features=cfg.d_model,
+                in_features=cfg.n_heads * cfg.head_dim,
+                name="out")(out, adapter_ids)
         causal = cfg.causal
         if cfg.decode:
             k, v, cache_mask = self._decode_cache(k, v, kv_positions)
@@ -391,10 +426,10 @@ class MultiHeadAttention(nn.Module):
         from jax.ad_checkpoint import checkpoint_name
         out = checkpoint_name(out, "attn_out")
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
-        return QuantDenseGeneral(
-            features=cfg.d_model, matmul_kernel=cfg.matmul_kernel,
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype, name="out")(out)
+        return _projection(
+            cfg, features=cfg.d_model,
+            in_features=cfg.n_heads * cfg.head_dim,
+            name="out")(out, adapter_ids)
 
     def _decode_cache(self, k, v, kv_positions=None):
         """KV-cache update (flax decode pattern): the "cache" collection
@@ -643,18 +678,16 @@ class MlpBlock(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, adapter_ids=None):
         cfg = self.cfg
-        h = QuantDense(cfg.d_ff, matmul_kernel=cfg.matmul_kernel,
-                       dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="up")(x)
+        h = _projection(cfg, features=cfg.d_ff, in_features=cfg.d_model,
+                        name="up", dense=True)(x, adapter_ids)
         h = nn.gelu(h)
         # named seat for remat policies that save the GELU output
         from jax.ad_checkpoint import checkpoint_name
         h = checkpoint_name(h, "mlp_act")
-        h = QuantDense(cfg.d_model, matmul_kernel=cfg.matmul_kernel,
-                       dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="down")(h)
+        h = _projection(cfg, features=cfg.d_model, in_features=cfg.d_ff,
+                        name="down", dense=True)(h, adapter_ids)
         if cfg.dropout > 0.0 and not deterministic:
             h = nn.Dropout(cfg.dropout)(h, deterministic=False)
         return h
@@ -665,14 +698,16 @@ class TransformerBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True, kv_positions=None,
-                 page_table=None):
+                 page_table=None, adapter_ids=None):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
         x = x + MultiHeadAttention(cfg, name="attn")(
             h, mask=mask, deterministic=deterministic,
-            kv_positions=kv_positions, page_table=page_table)
+            kv_positions=kv_positions, page_table=page_table,
+            adapter_ids=adapter_ids)
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
-        x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic)
+        x = x + MlpBlock(cfg, name="mlp")(h, deterministic=deterministic,
+                                          adapter_ids=adapter_ids)
         return x
 
 
@@ -687,11 +722,12 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, mask, kv_positions, page_table = carry
+        x, mask, kv_positions, page_table, adapter_ids = carry
         x = TransformerBlock(self.cfg, name="block")(
             x, mask=mask, deterministic=self.deterministic,
-            kv_positions=kv_positions, page_table=page_table)
-        return (x, mask, kv_positions, page_table), None
+            kv_positions=kv_positions, page_table=page_table,
+            adapter_ids=adapter_ids)
+        return (x, mask, kv_positions, page_table, adapter_ids), None
 
 
 def latch_eos(next_tokens: jax.Array, done: jax.Array, eos_id):
@@ -777,7 +813,7 @@ class TransformerStack(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True, kv_positions=None,
-                 page_table=None):
+                 page_table=None, adapter_ids=None):
         cfg = self.cfg
         if cfg.scan_layers:
             block_cls = _ScanBlock
@@ -795,14 +831,15 @@ class TransformerStack(nn.Module):
                 length=cfg.n_layers,
                 unroll=min(cfg.scan_unroll, cfg.n_layers),
                 metadata_params={nn.PARTITION_NAME: "layers"})
-            (x, _, _, _), _ = stack(cfg, deterministic, name="layers")(
-                (x, mask, kv_positions, page_table), None)
+            (x, _, _, _, _), _ = stack(cfg, deterministic, name="layers")(
+                (x, mask, kv_positions, page_table, adapter_ids), None)
             return x
         block_cls = maybe_remat(TransformerBlock, cfg,
                                 deterministic_argnum=3)
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block_{i}")(x, mask, deterministic,
-                                                  kv_positions, page_table)
+                                                  kv_positions, page_table,
+                                                  adapter_ids)
         return x
 
 
@@ -903,7 +940,7 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True, positions=None,
                  return_hidden: bool = False, kv_positions=None,
-                 page_table=None):
+                 page_table=None, adapter_ids=None):
         cfg = self.cfg
         B, T = tokens.shape
         if positions is None:  # decode mode passes cache-index positions
@@ -921,7 +958,7 @@ class TransformerLM(nn.Module):
                            param_dtype=cfg.param_dtype, name="wpe")(pos)
         x = TransformerStack(cfg, name="stack")(
             x, deterministic=deterministic, kv_positions=kv_positions,
-            page_table=page_table)
+            page_table=page_table, adapter_ids=adapter_ids)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             return x

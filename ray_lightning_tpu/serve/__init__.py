@@ -10,6 +10,9 @@ See ``docs/serving.md`` for the architecture. Quick start::
     out = client.run_until_idle()[rid]
     print(out.tokens, out.finish_reason)
 """
+from ray_lightning_tpu.serve.adapters import (AdapterBankFull,
+                                              AdapterRegistry,
+                                              UnknownAdapter)
 from ray_lightning_tpu.serve.client import ServeClient
 from ray_lightning_tpu.serve.engine import (KVSlotPool, PendingDispatch,
                                             ServeEngine, SlotPoolFull)
@@ -37,6 +40,7 @@ __all__ = [
     "ProcessReplicaFleet",
     "Router", "RouterConfig", "FleetConfig", "FleetSaturated",
     "TenantClass", "TenantScheduler", "ClassQueueFull", "DEFAULT_TENANT",
+    "AdapterRegistry", "AdapterBankFull", "UnknownAdapter",
     "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",
     "FINISH_TIMEOUT",
 ]

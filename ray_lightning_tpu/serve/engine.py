@@ -97,10 +97,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_lightning_tpu.models.generate import (_logits_only, _prefill_impl,
+from ray_lightning_tpu.models.generate import (_adapter_kw, _logits_only,
+                                               _prefill_impl,
                                                decode_step,
                                                decode_step_paged,
                                                sample_logits_rows)
+from ray_lightning_tpu.models.lora import (LoraConfig, adapter_bytes,
+                                           install_adapter,
+                                           install_lora_bank,
+                                           zero_adapter)
 from ray_lightning_tpu.models.quant import (DEFAULT_GROUP_SIZE,
                                             check_weight_dtype,
                                             materialize_for_program,
@@ -108,6 +113,8 @@ from ray_lightning_tpu.models.quant import (DEFAULT_GROUP_SIZE,
 from ray_lightning_tpu.models.transformer import latch_eos
 from ray_lightning_tpu.obs.spans import NULL_SPAN
 from ray_lightning_tpu.reliability import faults
+from ray_lightning_tpu.serve.adapters import (AdapterRegistry,
+                                              UnknownAdapter)
 from ray_lightning_tpu.serve.pages import (PagePool, PrefixCache,
                                            SlotPoolFull, check_kv_dtype,
                                            check_seed_free,
@@ -200,14 +207,17 @@ def _advance_rows(model, last, cur, pos, active, remaining, temp, top_k,
 
 
 def _engine_step_core(model, params, cache, cur, pos, active, remaining,
-                      temp, top_k, eos, keys, stepno):
+                      temp, top_k, eos, keys, stepno, adapter_ids=None):
     """One decode step for all B slots. Pure function of the engine state
     arrays; (B, 1) model step shared with generate() via decode_step,
     row bookkeeping shared with the page-native path via
     :func:`_advance_rows`. Re-writing a frozen row's K/V at its frozen
-    position is idempotent.
+    position is idempotent. ``adapter_ids`` (B,) routes each row through
+    its own resident LoRA pair (−1 = base model); ``None`` on engines
+    without an adapter bank — the model never sees the kwarg, so
+    unadapted programs are byte-for-byte the pre-LoRA ones.
     """
-    last, cache = decode_step(model, params, cache, cur, pos)
+    last, cache = decode_step(model, params, cache, cur, pos, adapter_ids)
     (cur, pos, active, remaining, stepno, emitted, finished) = \
         _advance_rows(model, last, cur, pos, active, remaining, temp,
                       top_k, eos, keys, stepno)
@@ -215,7 +225,8 @@ def _engine_step_core(model, params, cache, cur, pos, active, remaining,
 
 
 def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
-                      temp, top_k, eos, keys, stepno, *, steps):
+                      temp, top_k, eos, keys, stepno, adapter_ids=None,
+                      *, steps):
     """``steps`` decode steps in ONE dispatch (multi-step scheduling).
 
     Token-granularity dispatch pays the fixed per-call overhead once per
@@ -246,7 +257,7 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
         (cache, cur, pos, active, remaining, stepno, emitted,
          finished) = _engine_step_core(
             model, params, cache, cur, pos, active, remaining, temp,
-            top_k, eos, keys, stepno)
+            top_k, eos, keys, stepno, adapter_ids)
         return ((cache, cur, pos, active, remaining, stepno),
                 (emitted, finished))
 
@@ -258,7 +269,8 @@ def _engine_step_impl(model, params, cache, cur, pos, active, remaining,
 
 
 def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
-                         slots, valid, keys, temp, top_k, startno):
+                         slots, valid, keys, temp, top_k, startno,
+                         adapter_ids=None):
     """Batched prompt fill + first-token sample + KV injection (dense).
 
     Runs the standard single-pass prefill at the engine's fixed
@@ -282,7 +294,8 @@ def _prefill_inject_impl(model, params, pool_cache, prompts, lengths,
     storage = pool_cache
     pool_cache = dense_storage_values(model, storage)
     B_pf = prompts.shape[0]
-    pf_cache, last = _prefill_impl(model, params, prompts, lengths)
+    pf_cache, last = _prefill_impl(model, params, prompts, lengths,
+                                   adapter_ids)
     first_keys = _fold_rows(keys, startno)
     first = sample_logits_rows(last, first_keys, temp, top_k)
 
@@ -325,7 +338,8 @@ _scatter_pages = scatter_pages
 
 
 def _paged_step_impl(model, params, arena, page_table, cur, pos, active,
-                     remaining, temp, top_k, eos, keys, stepno, *, steps):
+                     remaining, temp, top_k, eos, keys, stepno,
+                     adapter_ids=None, *, steps):
     """The decode step program on paged storage: gather the dense view,
     run the IDENTICAL multi-step body (:func:`_engine_step_impl` — token
     identity with the dense engine is by construction), scatter mapped
@@ -346,13 +360,14 @@ def _paged_step_impl(model, params, arena, page_table, cur, pos, active,
     (view, cur, pos, active, remaining, stepno, emitted, finished) = \
         _engine_step_impl(model, params, view, cur, pos, active,
                           remaining, temp, top_k, eos, keys, stepno,
-                          steps=steps)
+                          adapter_ids, steps=steps)
     arena = _scatter_pages(model, arena, view, write_pt)
     return (arena, cur, pos, active, remaining, stepno, emitted, finished)
 
 
 def _prefill_inject_paged_impl(model, params, arena, prompts, lengths,
-                               inject_pt, keys, temp, top_k, startno):
+                               inject_pt, keys, temp, top_k, startno,
+                               adapter_ids=None):
     """Paged sibling of :func:`_prefill_inject_impl`: same prefill
     forward and first-token sample, but the injection is a page scatter —
     ``inject_pt`` (B_pf, pages_per_slot) maps each prefill row's pages to
@@ -361,7 +376,8 @@ def _prefill_inject_paged_impl(model, params, arena, prompts, lengths,
     ``max_seq_len`` row (positions ≥ P are zeros), so every mapped page
     is overwritten — stale KV from the pages' previous tenants never
     leaks (the paged analog of the dense whole-row inject)."""
-    pf_cache, last = _prefill_impl(model, params, prompts, lengths)
+    pf_cache, last = _prefill_impl(model, params, prompts, lengths,
+                                   adapter_ids)
     first_keys = _fold_rows(keys, startno)
     first = sample_logits_rows(last, first_keys, temp, top_k)
     # the prefill cache rows are already the dense per-slot view
@@ -371,7 +387,8 @@ def _prefill_inject_paged_impl(model, params, arena, prompts, lengths,
 
 
 def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
-                        valid_len, keys, temp, top_k, startno):
+                        valid_len, keys, temp, top_k, startno,
+                        adapter_ids=None):
     """One ``(1, C)`` chunk of one prompt, at absolute ``offset``.
 
     Gathers the request's dense row view from its pages, points the
@@ -395,7 +412,7 @@ def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
     positions = offset + jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
     outputs, updated = model.apply(
         {"params": params, "cache": view}, tokens, positions=positions,
-        deterministic=True, mutable=["cache"])
+        deterministic=True, mutable=["cache"], **_adapter_kw(adapter_ids))
     logits = _logits_only(outputs)                      # (1, C, V)
     last = jnp.take_along_axis(
         logits, jnp.reshape(valid_len - 1, (1, 1, 1)).astype(jnp.int32),
@@ -408,7 +425,7 @@ def _chunk_prefill_impl(model, params, arena, row_pages, tokens, offset,
 
 def _page_native_step_impl(model, params, arena, page_table, cur, pos,
                            active, remaining, temp, top_k, eos, keys,
-                           stepno, *, steps):
+                           stepno, adapter_ids=None, *, steps):
     """The decode step program in **page-native** mode: K/V reads and
     writes go straight through the page table inside the model's
     attention (``decode_step_paged`` →
@@ -431,7 +448,7 @@ def _page_native_step_impl(model, params, arena, page_table, cur, pos,
     def body(carry, _):
         arena, cur, pos, active, remaining, stepno = carry
         last, arena = decode_step_paged(model, params, arena, cur, pos,
-                                        page_table)
+                                        page_table, adapter_ids)
         (cur, pos, active, remaining, stepno, emitted, finished) = \
             _advance_rows(model, last, cur, pos, active, remaining,
                           temp, top_k, eos, keys, stepno)
@@ -615,7 +632,10 @@ class ServeEngine:
                  draft_model=None, draft_params=None,
                  spec_k: Optional[int] = None,
                  draft_weight_dtype: Optional[str] = None,
-                 tenant_classes=None):
+                 tenant_classes=None,
+                 adapters=None,
+                 max_resident_adapters: Optional[int] = None,
+                 lora_rank: Optional[int] = None):
         cfg = model.cfg
         if not cfg.decode:
             raise ValueError(
@@ -758,6 +778,52 @@ class ServeEngine:
         # engine only enforces quotas, it never reorders anything.
         self.tenant_classes = (resolve_tenant_classes(tenant_classes)
                                if tenant_classes else None)
+        # batched multi-LoRA serving (models/lora.py + serve/adapters.py):
+        # max_resident_adapters= sizes a resident (N, ...) adapter bank
+        # on every LoRA-target projection — the bank axis is part of the
+        # compiled programs, so hot load/unload/eviction is a data write,
+        # never a recompile, and rows bound to different adapters batch
+        # in one dispatch. The model is cloned with the LoraConfig here
+        # (the attention_kernel/matmul_kernel pattern): supervisor
+        # rebuilds and fleet replicas re-enter this ctor with the same
+        # kwargs and re-arm the identical bank.
+        self.max_resident_adapters = max_resident_adapters
+        self.lora_rank = lora_rank
+        if max_resident_adapters is None:
+            if adapters:
+                raise ValueError(
+                    "adapters= needs max_resident_adapters= too: the "
+                    "bank's num_adapters axis is part of the compiled "
+                    "programs and must be sized up front")
+            if lora_rank is not None:
+                raise ValueError(
+                    "lora_rank is a multi-LoRA serving option: pass "
+                    "max_resident_adapters= to arm the adapter bank")
+        else:
+            if max_resident_adapters < 1:
+                raise ValueError(
+                    f"max_resident_adapters must be >= 1, got "
+                    f"{max_resident_adapters}")
+            if lora_rank is None or lora_rank < 1:
+                raise ValueError(
+                    "multi-LoRA serving needs lora_rank >= 1 (the bank's "
+                    f"low-rank dimension), got {lora_rank!r}")
+            if adapters and len(adapters) > max_resident_adapters:
+                raise ValueError(
+                    f"{len(adapters)} initial adapters exceed "
+                    f"max_resident_adapters={max_resident_adapters}")
+            if cfg.scan_layers:
+                raise ValueError(
+                    "multi-LoRA serving needs scan_layers=False: the "
+                    "bank graft walks unrolled layer scopes (serving "
+                    "wants unrolled layers anyway — unstack_scan_params "
+                    "the weights; docs/performance.md decode section)")
+            lora_cfg = LoraConfig(rank=lora_rank,
+                                  num_adapters=max_resident_adapters)
+            if cfg.lora != lora_cfg:
+                model = model.clone(
+                    cfg=dataclasses.replace(cfg, lora=lora_cfg))
+                cfg = model.cfg
         self.model = model
         # weight-only quantization (models/quant.py): storage-only —
         # the programs dequantize once per dispatch, compute stays at
@@ -778,6 +844,29 @@ class ServeEngine:
                 weight_group_size if draft_weight_dtype == "int4"
                 else None)
         self.params = params
+        # adapter bank graft AFTER weight quantization: the zero-filled
+        # (N, ...) lora_A/lora_B banks ride next to the (possibly
+        # QTensor) base kernels at full precision — quantize_params
+        # skips lora_* leaves by name, and grafting here keeps them out
+        # of the quantizer entirely. The LoRA delta therefore rides
+        # OUTSIDE the quantized base matmul (pallas fused kernels
+        # included), which is what makes the null-adapter row bitwise
+        # the unadapted engine.
+        self._registry: Optional[AdapterRegistry] = None
+        self._adapter_ids: Optional[np.ndarray] = None
+        self._adapter_of: Dict[int, str] = {}
+        self._adapter_events: List[dict] = []
+        if max_resident_adapters is not None:
+            self.params = install_lora_bank(self.params, cfg.lora)
+            self._registry = AdapterRegistry(
+                max_resident_adapters,
+                bytes_per_adapter=adapter_bytes(self.params))
+            self._adapter_ids = np.full((num_slots,), -1, np.int32)
+            for name, tree in dict(adapters or {}).items():
+                index, _ = self._registry.admit(name)
+                self.params = install_adapter(self.params, tree, index)
+                self._adapter_events.append(
+                    dict(adapter=name, index=index, evicted=None))
         self.num_slots = num_slots
         if prefill_batch is not None and prefill_batch < 1:
             raise ValueError(
@@ -897,7 +986,12 @@ class ServeEngine:
             ).set(param_bytes(self.params)
                   + (param_bytes(self.spec.params)
                      if self.spec is not None else 0))
+            if self._registry is not None:
+                for payload in self._adapter_events:
+                    telemetry.event("engine.adapter_loaded", **payload)
+                self._set_adapter_gauge()
         self._weights_quantized_events = []
+        self._adapter_events = []
 
     def _quantize_weights(self, which: str, params, weight_dtype: str,
                           group_size: Optional[int]):
@@ -914,6 +1008,128 @@ class ServeEngine:
                         else group_size or DEFAULT_GROUP_SIZE),
             bytes_before=before, bytes_after=param_bytes(quantized)))
         return quantized
+
+    # ----------------------------------------------------- multi-LoRA
+    @property
+    def resident_adapters(self) -> List[str]:
+        """Resident adapter names, least-recently-bound first (the
+        deterministic eviction order); empty without a bank."""
+        return (self._registry.residents
+                if self._registry is not None else [])
+
+    def adapter_bank_bytes(self) -> int:
+        """Exact at-rest device bytes of the full adapter bank
+        (``capacity * per-adapter slice`` from
+        :func:`~ray_lightning_tpu.models.lora.adapter_bytes`) — the
+        bench's enforced accounting floor."""
+        if self._registry is None:
+            return 0
+        return self._registry.capacity * self._registry.bytes_per_adapter
+
+    def adapter_refcount(self, name: str) -> int:
+        """In-flight rows currently pinned to ``name`` (0 when disarmed
+        or not resident) — the fleet's pre-unload broadcast check."""
+        return (self._registry.refcount(name)
+                if self._registry is not None else 0)
+
+    def _set_adapter_gauge(self) -> None:
+        self._tel.metrics.gauge(
+            "serve_adapter_resident",
+            help="LoRA adapters currently resident in the engine's "
+            "adapter bank"
+        ).set(len(self._registry.residents))
+
+    def load_adapter(self, name: str, adapter) -> Optional[str]:
+        """Hot-load (or overwrite) adapter ``name`` into the resident
+        bank: claim a bank index (reusing ``name``'s own, else a free
+        slot, else deterministically evicting the LRU unpinned
+        resident), write the ``(A, B)`` slices in place, no recompile.
+        Returns the evicted adapter's name (its future submits shed
+        with :class:`~ray_lightning_tpu.serve.adapters.UnknownAdapter`,
+        like a :class:`~ray_lightning_tpu.serve.tenancy.ClassQueueFull`
+        shed) or ``None``. Needs the synced frontier, like every other
+        barrier — the async client drains its pipeline first."""
+        if self._registry is None:
+            raise ValueError(
+                "this engine has no adapter bank — pass "
+                "max_resident_adapters=/lora_rank= to arm multi-LoRA "
+                "serving")
+        self._require_synced("load_adapter")
+        index, evicted = self._registry.admit(name)
+        self.params = install_adapter(self.params, adapter, index)
+        tel = self._tel
+        if tel is not None:
+            if evicted is not None:
+                tel.event("engine.adapter_evicted", adapter=evicted,
+                          index=index, by=name)
+            tel.event("engine.adapter_loaded", adapter=name,
+                      index=index, evicted=evicted)
+            self._set_adapter_gauge()
+        return evicted
+
+    def unload_adapter(self, name: str) -> None:
+        """Release ``name``'s bank slot (refused while in-flight rows
+        pin it) and zero its slices — the freed index serves the next
+        load with no stale low-rank residue."""
+        if self._registry is None:
+            raise ValueError(
+                "this engine has no adapter bank — pass "
+                "max_resident_adapters=/lora_rank= to arm multi-LoRA "
+                "serving")
+        self._require_synced("unload_adapter")
+        index = self._registry.unload(name)
+        self.params = zero_adapter(self.params, index)
+        tel = self._tel
+        if tel is not None:
+            tel.event("engine.adapter_unloaded", adapter=name,
+                      index=index)
+            self._set_adapter_gauge()
+
+    def _effective_adapter(self, request: Request) -> Optional[str]:
+        """The adapter this request decodes under: its own binding,
+        else its tenant class's default (``TenantClass.adapter=``),
+        else ``None`` (the base model)."""
+        name = getattr(request, "adapter", None)
+        if name is None and self.tenant_classes is not None:
+            cls = self.tenant_classes.get(request.tenant)
+            if cls is not None:
+                name = getattr(cls, "adapter", None)
+        return name
+
+    def _bind_adapter(self, req: Request, slot: int) -> int:
+        """Pin the request's adapter at admission (inside the atomic
+        try block — a mid-batch reject unbinds via
+        :meth:`_unbind_adapter`): bumps the registry refcount so
+        eviction can never pull a bank slot out from under an in-flight
+        row, arms the slot's row id, and stamps the resolved name onto
+        the request so crash replay and fleet failover re-bind the
+        identical adapter. Returns the bank index (−1 = base model)."""
+        name = self._effective_adapter(req)
+        if name is None or self._registry is None:
+            return -1
+        index = self._registry.bind(name)   # UnknownAdapter if evicted
+        self._adapter_ids[slot] = index
+        self._adapter_of[slot] = name
+        req.adapter = name
+        tel = self._tel
+        if tel is not None:
+            tel.event("engine.adapter_bound", id=req.id, adapter=name,
+                      slot=slot, index=index)
+            tel.metrics.counter(
+                f"serve_adapter_requests_total_{name}",
+                help="requests admitted under this LoRA adapter"
+            ).inc()
+        return index
+
+    def _unbind_adapter(self, slot: int) -> None:
+        """Drop a slot's adapter pin (retire/cancel/admission
+        rollback); no-op for base-model rows and disarmed engines."""
+        if self._registry is None:
+            return
+        name = self._adapter_of.pop(slot, None)
+        if name is not None:
+            self._registry.unbind(name)
+        self._adapter_ids[slot] = -1
 
     # ------------------------------------------------------------- state
     @property
@@ -1001,6 +1217,9 @@ class ServeEngine:
             "free_slots": self.free_slots,
             "free_pages": self.free_pages,
             "num_pages": self.pool.num_pages if self.paged else None,
+            "resident_adapters": (len(self._registry.residents)
+                                  if self._registry is not None
+                                  else None),
         }
 
     @property
@@ -1028,6 +1247,19 @@ class ServeEngine:
                 f"request names tenant {tenant!r} but the engine has no "
                 "tenant classes configured — pass tenant_classes= to "
                 "arm multi-tenant scheduling")
+        # adapter refusal belongs HERE, at submit — an undeclared or
+        # evicted adapter must shed with registry context (the
+        # ClassQueueFull pattern), never reach a dispatch as a garbage
+        # bank gather
+        adapter = self._effective_adapter(request)
+        if adapter is not None:
+            if self._registry is None:
+                raise UnknownAdapter(
+                    f"request names adapter {adapter!r} but the engine "
+                    "has no adapter bank — pass max_resident_adapters=/"
+                    "lora_rank= to arm multi-LoRA serving",
+                    adapter=adapter, resident=[], capacity=0)
+            self._registry.index_of(adapter)  # UnknownAdapter + context
         if self.prefill_chunk is None \
                 and request.prompt_len > self.prefill_len:
             raise ValueError(
@@ -1189,6 +1421,10 @@ class ServeEngine:
         temp = np.zeros((B_pf,), np.float32)
         top_k = np.zeros((B_pf,), np.int32)
         startno = np.zeros((B_pf,), np.int32)
+        # per-row adapter bank ids (−1 = base model, padding rows too —
+        # their delta is masked to exact zero, so they stay bitwise the
+        # unadapted computation)
+        adapter_row = np.full((B_pf,), -1, np.int32)
         acquired: List[int] = []
         batched: List[Request] = []
         adoptions: List[Tuple[int, int, Request]] = []
@@ -1205,6 +1441,7 @@ class ServeEngine:
                     adopt = self._adoptable_prefix(fed)
                     slot = self._admit_paged(req, adopt)
                     acquired.append(slot)
+                    self._bind_adapter(req, slot)
                     hit = len(adopt) * self.pool.page_size
                     req.prefix_hit_tokens = hit
                     self._chunk_queue.append(_ChunkState(
@@ -1228,6 +1465,7 @@ class ServeEngine:
                         if self.paged else self.pool.acquire(req))
                 acquired.append(slot)
                 r = len(batched)
+                adapter_row[r] = self._bind_adapter(req, slot)
                 batched.append(req)
                 prompts[r, :L] = fed
                 lengths[r] = L
@@ -1249,6 +1487,7 @@ class ServeEngine:
             # loses some cache warmth, never tokens
             for slot in acquired:
                 self.pool.release(slot)
+                self._unbind_adapter(slot)
             for _ in range(n_chunked):
                 self._chunk_queue.pop()
             raise
@@ -1274,18 +1513,24 @@ class ServeEngine:
             slots[r] = acquired[0]
 
         tel = self._tel
+        # None when disarmed: the kwargs guard (_adapter_kw) then keeps
+        # the traced programs byte-for-byte the pre-LoRA ones, and model
+        # families without the adapter_ids kwarg never see it
+        adapter_arg = adapter_row if self._registry is not None else None
         with (tel.span("engine.prefill", n=len(batched))
               if tel is not None else NULL_SPAN):
             if self.paged:
                 fn = _pick(_prefill_paged_donated, _prefill_paged_plain)
                 self.pool.arena, first = fn(
                     self.model, self.params, self.pool.arena, prompts,
-                    lengths, inject_pt, keys, temp, top_k, startno)
+                    lengths, inject_pt, keys, temp, top_k, startno,
+                    adapter_arg)
             else:
                 fn = _pick(_prefill_inject_donated, _prefill_inject_plain)
                 self.pool.cache, first = fn(
                     self.model, self.params, self.pool.cache, prompts,
-                    lengths, slots, valid, keys, temp, top_k, startno)
+                    lengths, slots, valid, keys, temp, top_k, startno,
+                    adapter_arg)
             first = np.asarray(first)
         if tel is not None:
             tel.event("engine.prefill", n=len(batched),
@@ -1329,13 +1574,15 @@ class ServeEngine:
         startno = np.array([len(req.replay_tokens or ())], np.int32)
         row_pages = np.array(self.pool.page_table[st.slot])
         tel = self._tel
+        adapter_arg = (np.array([self._adapter_ids[st.slot]], np.int32)
+                       if self._registry is not None else None)
         fn = _pick(_chunk_prefill_donated, _chunk_prefill_plain)
         with (tel.span("engine.chunk", id=req.id, off=off, n=valid)
               if tel is not None else NULL_SPAN):
             self.pool.arena, first = fn(
                 self.model, self.params, self.pool.arena, row_pages,
                 tokens, np.int32(off), np.int32(valid), keys, temp,
-                top_k, startno)
+                top_k, startno, adapter_arg)
             first = np.asarray(first)
         st.next_off = off + valid
         self.chunk_dispatches += 1
@@ -1474,6 +1721,7 @@ class ServeEngine:
                     self._write_masked_table(), cur, pos,
                     active, remaining, self._temp,
                     self._top_k, self._eos, self._keys, stepno,
+                    self._adapter_ids,
                     steps=self.steps_per_dispatch)
             elif self.paged:
                 fn = _pick(_paged_step_donated, _paged_step_plain)
@@ -1487,6 +1735,7 @@ class ServeEngine:
                     np.array(self.pool.page_table), cur, pos,
                     active, remaining, self._temp,
                     self._top_k, self._eos, self._keys, stepno,
+                    self._adapter_ids,
                     steps=self.steps_per_dispatch)
             else:
                 fn = _pick(_engine_step_donated, _engine_step_plain)
@@ -1495,6 +1744,7 @@ class ServeEngine:
                     self.model, self.params, self.pool.cache, cur,
                     pos, active, remaining, self._temp,
                     self._top_k, self._eos, self._keys, stepno,
+                    self._adapter_ids,
                     steps=self.steps_per_dispatch)
         self._carry = (cur, pos, active, remaining, stepno)
         self.steps += 1
@@ -1642,7 +1892,8 @@ class ServeEngine:
                     self.pool.arena, self._write_masked_table(),
                     spec.cache, cur, pos, act,
                     remaining, self._temp, self._top_k, self._eos,
-                    self._keys, stepno, k=k, rounds=rounds)
+                    self._keys, stepno, self._adapter_ids,
+                    k=k, rounds=rounds)
             elif self.paged:
                 fn = _pick(_spec_paged_donated, _spec_paged_plain)
                 (self.pool.arena, spec.cache, cur, pos, act, remaining,
@@ -1651,7 +1902,8 @@ class ServeEngine:
                     self.pool.arena, np.array(self.pool.page_table),
                     spec.cache, cur, pos, act,
                     remaining, self._temp, self._top_k, self._eos,
-                    self._keys, stepno, k=k, rounds=rounds)
+                    self._keys, stepno, self._adapter_ids,
+                    k=k, rounds=rounds)
             else:
                 fn = _pick(_spec_rounds_donated, _spec_rounds_plain)
                 (self.pool.cache, spec.cache, cur, pos, act, remaining,
@@ -1660,7 +1912,7 @@ class ServeEngine:
                     self.pool.cache, spec.cache, cur, pos,
                     act, remaining, self._temp,
                     self._top_k, self._eos, self._keys, stepno,
-                    k=k, rounds=rounds)
+                    self._adapter_ids, k=k, rounds=rounds)
         self._carry = (cur, pos, act, remaining, stepno)
         self.steps += 1
         # one verify = one target param read, however many tokens it
@@ -1783,6 +2035,7 @@ class ServeEngine:
         self.spec = None
         self._chunk_queue.clear()
         self._tokens.clear()
+        self._adapter_of.clear()
         # an in-flight enqueued dispatch is DISCARDED with the carry —
         # the sync-frontier contract: its tokens were never committed,
         # and (failover) a replay regenerates them elsewhere
@@ -1798,6 +2051,7 @@ class ServeEngine:
                 st for st in self._chunk_queue if st.slot != slot)
         req = self.pool.release(slot)
         self._active[slot] = False
+        self._unbind_adapter(slot)
         if self.spec is not None:
             # a cancel between activation and the next spec dispatch
             # must not refill a slot that no longer holds the request
@@ -1813,4 +2067,4 @@ class ServeEngine:
             finish_reason=reason, arrival_time=req.arrival_time,
             first_token_time=req.first_token_time,
             prefix_hit_tokens=req.prefix_hit_tokens,
-            tenant=req.tenant)
+            tenant=req.tenant, adapter=getattr(req, "adapter", None))

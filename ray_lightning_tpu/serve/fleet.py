@@ -602,10 +602,12 @@ class ReplicaFleet:
                temperature: float = 0.0, top_k: Optional[int] = None,
                eos_id: Optional[int] = None, seed: Optional[int] = None,
                deadline: Optional[float] = None,
-               tenant: Optional[str] = None) -> int:
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> int:
         """Route + enqueue one request; returns its fleet-wide id.
         Raises ``ValueError`` for requests no replica could ever fit
-        (or that name an undeclared tenant) and
+        (or that name an undeclared tenant, or an ``adapter`` not
+        resident fleet-wide) and
         :class:`FleetSaturated` when every replica refuses — a class at
         its per-replica quota sheds ``ClassQueueFull`` to the next
         candidate exactly like any other refusal."""
@@ -613,7 +615,8 @@ class ReplicaFleet:
                       max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, eos_id=eos_id,
                       seed=seed, deadline=deadline,
-                      tenant=tenant or DEFAULT_TENANT)
+                      tenant=tenant or DEFAULT_TENANT,
+                      adapter=adapter)
         self._admit(req)
         self._next_id += 1
         return req.id
@@ -658,6 +661,68 @@ class ReplicaFleet:
             replicas=len(ranked),
             class_depths=class_depths or None,
             class_oldest=class_oldest or None)
+
+    # ---------------------------------------------------- hot adapters
+    def load_adapter(self, name: str, adapter) -> Optional[str]:
+        """Broadcast a hot adapter load to every live replica, keeping
+        the whole fleet's resident set in lockstep (any replica can
+        seat any request — including a failover re-admission bound to
+        this adapter). Every replica holds the SAME resident names by
+        construction (identical initial ``adapters=`` kwargs, then only
+        lockstep broadcasts), so when the bank is full the fleet evicts
+        ONE fleet-chosen victim — the oldest fleet-level load — via an
+        explicit unload broadcast first; per-replica LRU eviction
+        (which could diverge across replicas whose bind recencies
+        differ with routing) never triggers under fleet ops. Returns
+        the evicted name, or ``None``. Refuses
+        (:class:`~ray_lightning_tpu.serve.request.OccupancyError`) when
+        the would-be victim is pinned by in-flight rows anywhere."""
+        resident = dict(self._engine_kwargs.get("adapters") or {})
+        cap = self._engine_kwargs.get("max_resident_adapters")
+        evicted: Optional[str] = None
+        if (name not in resident and cap is not None
+                and len(resident) >= int(cap)):
+            evicted = next(iter(resident))
+            self.unload_adapter(evicted)
+            resident = dict(self._engine_kwargs.get("adapters") or {})
+        for rep in self._replicas:
+            rep.client.load_adapter(name, adapter)
+        self._sweep_barrier_completions()
+        resident[name] = adapter
+        self._engine_kwargs["adapters"] = resident
+        return evicted
+
+    def unload_adapter(self, name: str) -> None:
+        """Broadcast a hot unload. Atomic fleet-wide: every replica's
+        pipeline is drained and its refcount checked BEFORE any replica
+        unloads, so a pinned adapter refuses without leaving the fleet's
+        resident sets diverged."""
+        for rep in self._replicas:
+            rep.client._drain_for_barrier()
+            refs = rep.client.engine.adapter_refcount(name)
+            if refs:
+                self._sweep_barrier_completions()
+                raise OccupancyError(
+                    f"cannot unload adapter {name!r}: {refs} in-flight "
+                    f"request(s) on replica {rep.id} still bound to it",
+                    adapter=name, replica=rep.id, refcount=refs)
+        for rep in self._replicas:
+            rep.client.unload_adapter(name)
+        self._sweep_barrier_completions()
+        resident = dict(self._engine_kwargs.get("adapters") or {})
+        resident.pop(name, None)
+        self._engine_kwargs["adapters"] = resident
+
+    def _sweep_barrier_completions(self) -> None:
+        """Adapter barriers drain each replica's pipelined dispatch
+        inside the client, so completions the drain retires land in the
+        client's ledger without passing through a ``tick()`` return —
+        sweep them into the fleet's (same contract as the failover
+        ledger sweep)."""
+        for rep in self._replicas:
+            for rid, comp in rep.client.completions.items():
+                if rid not in self.completions:
+                    self._note_completion(rep, comp)
 
     # ------------------------------------------------------------- loop
     def tick(self) -> List[Completion]:
@@ -883,6 +948,20 @@ class ReplicaFleet:
                 return None, None
             client = self._build_client()
             source = "cold"
+        elif self._engine_kwargs.get("max_resident_adapters"):
+            # a warm standby was built with the kwargs as of pool-fill
+            # time; hot adapter churn since must be replayed onto it
+            # BEFORE it serves — a stale bank would refuse re-admitted
+            # adapter-bound requests as UnknownAdapter. (Cold builds
+            # read the current kwargs and need nothing.) Loading every
+            # wanted adapter unconditionally also repairs overwrites:
+            # a resident name reuses its index, a slice write is cheap.
+            want = dict(self._engine_kwargs.get("adapters") or {})
+            for name in list(client.engine.resident_adapters):
+                if name not in want:
+                    client.unload_adapter(name)
+            for name, tree in want.items():
+                client.load_adapter(name, tree)
         rep = self._adopt(client)
         if self.standby is not None:
             self.standby.refill_async(self._build_client)
@@ -1020,7 +1099,8 @@ class ReplicaFleet:
                         prompt=[int(t) for t in kwargs.get("prompt", [])],
                         tokens=[], finish_reason=FINISH_REJECTED,
                         arrival_time=now, finish_time=now,
-                        tenant=kwargs.get("tenant") or DEFAULT_TENANT)
+                        tenant=kwargs.get("tenant") or DEFAULT_TENANT,
+                        adapter=kwargs.get("adapter"))
                     if tel is not None:
                         tel.event(EVENT_SHED, id=rid,
                                   why=type(exc).__name__,

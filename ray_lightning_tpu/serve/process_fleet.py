@@ -429,18 +429,39 @@ class ProcessReplicaFleet(ReplicaFleet):
                temperature: float = 0.0, top_k: Optional[int] = None,
                eos_id: Optional[int] = None, seed: Optional[int] = None,
                deadline: Optional[float] = None,
-               tenant: Optional[str] = None) -> int:
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> int:
         """Route + enqueue one request; same contract as the in-process
         fleet (``ValueError`` for never-fits, :class:`FleetSaturated`
-        when every replica refuses)."""
+        when every replica refuses). ``adapter=`` rides the request
+        across the transport — every worker's engine was built with the
+        fleet's ``adapters=`` kwargs, so binding happens worker-side."""
         req = Request(id=self._next_id, prompt=prompt,
                       max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, eos_id=eos_id,
                       seed=seed, deadline=deadline,
-                      tenant=tenant or DEFAULT_TENANT)
+                      tenant=tenant or DEFAULT_TENANT,
+                      adapter=adapter)
         self._admit(req)
         self._next_id += 1
         return req.id
+
+    # ---------------------------------------------------- hot adapters
+    def load_adapter(self, name: str, adapter) -> Optional[str]:
+        """Hot adapter churn needs a broadcast RPC the process
+        transport does not carry yet — declare the resident set up
+        front via ``adapters=`` (every worker engine builds with it),
+        or use the in-process backend for hot load/unload."""
+        raise NotImplementedError(
+            "hot adapter load/unload is not supported on the process "
+            "backend — pass the resident set via adapters= at fleet "
+            "build, or use backend='inproc'")
+
+    def unload_adapter(self, name: str) -> None:
+        raise NotImplementedError(
+            "hot adapter load/unload is not supported on the process "
+            "backend — pass the resident set via adapters= at fleet "
+            "build, or use backend='inproc'")
 
     def _admit(self, req: Request) -> _ProcessReplica:
         """Offer ``req`` down the router's preference order via submit

@@ -90,6 +90,14 @@ class Request:
     # KV was adopted from the shared-prefix cache instead of computed
     # (0 = no hit / dense engine); surfaced on the Completion
     prefix_hit_tokens: int = 0
+    # LoRA adapter name (multi-adapter serving, serve/adapters.py):
+    # which resident adapter's (A, B) pair this request's batch rows
+    # gather inside the shared programs. None = the base model
+    # (bit-identical to an unadapted engine). Like ``tenant``, the
+    # binding rides the request object through crash replay and fleet
+    # failover re-admission; a TenantClass.adapter default is resolved
+    # at engine admission, not here.
+    adapter: Optional[str] = None
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]
@@ -106,6 +114,11 @@ class Request:
         if not self.tenant or not isinstance(self.tenant, str):
             raise ValueError(
                 f"tenant must be a non-empty string, got {self.tenant!r}")
+        if self.adapter is not None and (
+                not self.adapter or not isinstance(self.adapter, str)):
+            raise ValueError(
+                f"adapter must be a non-empty string or None, "
+                f"got {self.adapter!r}")
         if self.seed is None:
             self.seed = self.id
 
@@ -130,6 +143,9 @@ class Completion:
     # the retiring request's tenant class (per-tenant obs + bench
     # aggregation key; DEFAULT_TENANT without tenancy configured)
     tenant: str = DEFAULT_TENANT
+    # the adapter this request actually decoded under (after any
+    # TenantClass.adapter default resolution; None = base model)
+    adapter: Optional[str] = None
 
     @property
     def latency(self) -> Optional[float]:

@@ -243,7 +243,8 @@ def _draft_propose(draft_model, draft_params, draft_cache, cur, pos,
 
 def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
                       draft_cache, cur, pos, active, remaining, temp,
-                      top_k, eos, keys, stepno, *, k, rounds):
+                      top_k, eos, keys, stepno, adapter_ids=None, *,
+                      k, rounds):
     """``rounds`` spec rounds in ONE dispatch. Each round: k+1 draft
     single-token steps (the extra feed writes the last proposal's K/V so
     a fully-accepted round leaves the draft cache covering every
@@ -258,6 +259,15 @@ def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
     ``cache`` may be int8 dense storage, handled like the plain step;
     ``params``/``draft_params`` may be weight-quantized — dequantized
     here once per dispatch, outside the round scan.
+
+    ``adapter_ids`` (B,) per-row LoRA bank ids reach the TARGET verify
+    only: the spec identity contract is "same committed tokens as the
+    non-spec engine", and that engine's tokens come from the (adapted)
+    target distribution — greedy acceptance compares draft proposals
+    against the adapted argmax, sampled acceptance corrects toward the
+    adapted ``p``, so the draft model stays UNADAPTED (one draft serves
+    every adapter; a mismatched draft only costs acceptance rate, never
+    correctness).
     """
     params = materialize_for_program(params, model.cfg)
     draft_params = materialize_for_program(draft_params, draft_model.cfg)
@@ -272,7 +282,8 @@ def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
             stepno, temp, top_k, max_pos, k=k)
         tokens_in = jnp.concatenate([cur, draft_toks], axis=1)
         vpos = jnp.minimum(pos + jnp.arange(k + 1)[None, :], max_pos)
-        L, cache = verify_step(model, params, cache, tokens_in, vpos)
+        L, cache = verify_step(model, params, cache, tokens_in, vpos,
+                               adapter_ids)
         (cur, pos, active, remaining, stepno, emitted, accepted,
          rejected, finished) = _spec_accept(
             L, draft_toks, draft_logits, cur, pos, active, remaining,
@@ -293,7 +304,7 @@ def _spec_rounds_impl(model, draft_model, params, draft_params, cache,
 def _spec_rounds_paged_impl(model, draft_model, params, draft_params,
                             arena, page_table, draft_cache, cur, pos,
                             active, remaining, temp, top_k, eos, keys,
-                            stepno, *, k, rounds):
+                            stepno, adapter_ids=None, *, k, rounds):
     """The spec round program on paged target storage: gather the dense
     view (dequantizing int8 arenas), run the IDENTICAL rounds body,
     scatter mapped pages back — rows inactive at dispatch entry are
@@ -304,7 +315,7 @@ def _spec_rounds_paged_impl(model, draft_model, params, draft_params,
      accepted, rejected, finished) = _spec_rounds_impl(
         model, draft_model, params, draft_params, view, draft_cache,
         cur, pos, active, remaining, temp, top_k, eos, keys, stepno,
-        k=k, rounds=rounds)
+        adapter_ids, k=k, rounds=rounds)
     arena = scatter_pages(model, arena, view, write_pt)
     return (arena, draft_cache, cur, pos, active, remaining, stepno,
             emitted, accepted, rejected, finished)
@@ -314,7 +325,8 @@ def _spec_rounds_page_native_impl(model, draft_model, params,
                                   draft_params, arena, page_table,
                                   draft_cache, cur, pos, active,
                                   remaining, temp, top_k, eos, keys,
-                                  stepno, *, k, rounds):
+                                  stepno, adapter_ids=None, *, k,
+                                  rounds):
     """The spec round program in **page-native** mode: the widened
     ``(B, k+1)`` verify reads and writes target K/V straight through
     the (write-masked) page table inside the model's attention
@@ -338,7 +350,7 @@ def _spec_rounds_page_native_impl(model, draft_model, params,
         tokens_in = jnp.concatenate([cur, draft_toks], axis=1)
         vpos = jnp.minimum(pos + jnp.arange(k + 1)[None, :], max_pos)
         L, arena = verify_step_paged(model, params, arena, tokens_in,
-                                     vpos, page_table)
+                                     vpos, page_table, adapter_ids)
         (cur, pos, active, remaining, stepno, emitted, accepted,
          rejected, finished) = _spec_accept(
             L, draft_toks, draft_logits, cur, pos, active, remaining,

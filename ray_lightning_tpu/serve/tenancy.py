@@ -119,6 +119,13 @@ class TenantClass:
     concurrently (decoding + chunk-prefilling); a class at its slot
     quota contributes no admission candidates until a slot retires, so
     a batch class can be fenced off a reserved interactive slot.
+
+    ``adapter``: the class's default LoRA adapter (multi-adapter
+    serving, serve/adapters.py) — requests in this class submitted
+    without an explicit ``adapter=`` decode under it; an explicit
+    per-request adapter always wins. Resolution happens at engine
+    admission (the resolved name is stamped onto the request, so
+    crash replay and fleet failover re-bind identically).
     """
     name: str
     weight: float = 1.0
@@ -127,6 +134,7 @@ class TenantClass:
     default_deadline: Optional[float] = None
     max_queue_depth: Optional[int] = None
     max_active_slots: Optional[int] = None
+    adapter: Optional[str] = None
 
     def __post_init__(self):
         if not self.name or not isinstance(self.name, str):
@@ -149,6 +157,10 @@ class TenantClass:
         if self.max_active_slots is not None and self.max_active_slots < 1:
             raise ValueError(f"max_active_slots must be >= 1, got "
                              f"{self.max_active_slots}")
+        if self.adapter is not None and (
+                not self.adapter or not isinstance(self.adapter, str)):
+            raise ValueError(f"adapter must be a non-empty string or "
+                             f"None, got {self.adapter!r}")
 
 
 def resolve_tenant_classes(

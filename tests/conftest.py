@@ -116,6 +116,11 @@ def pytest_configure(config):
         "replica, queue-transport results, heartbeat-channel clock) — "
         "`pytest -m fleet_process` runs it as a targeted subset")
     config.addinivalue_line(
+        "markers", "lora: batched multi-LoRA serving (resident adapter "
+        "bank, hot load/unload registry, per-row adapter gather, "
+        "train→serve lifecycle) — `pytest -m lora` runs it as a fast "
+        "targeted subset")
+    config.addinivalue_line(
         "markers", "slow: heavy multi-process / wall-clock cases "
         "excluded from the tier-1 gate (`-m 'not slow'`); run them "
         "with `pytest -m slow`")

@@ -1,0 +1,111 @@
+"""Lint: every ServeEngine serve-config kwarg rides the rebuild plumbing.
+
+Sibling of the ``test_lint_*`` family (``test_lint_obs_docs.py``
+precedent): make a wiring contract structural instead of a review
+catch. :class:`~ray_lightning_tpu.serve.client.ServeClient` forwards
+engine configuration through ONE explicit ``engine_kwargs = dict(...)``
+literal — the same dict a :class:`~ray_lightning_tpu.reliability.
+supervisor.ServeSupervisor` stores for crash rebuilds and a
+:class:`~ray_lightning_tpu.serve.fleet.ReplicaFleet` replays to build
+replicas and warm standbys (those two take ``**engine_kwargs``
+verbatim, so they can never drop a key; the client's literal is the
+single choke point that can).
+
+History says this drops silently: a new ``ServeEngine.__init__`` kwarg
+that never lands in the client literal "works" on a bare engine, then a
+supervised crash rebuilds WITHOUT it — the rebuilt engine silently
+loses its paged KV / tenancy / adapter bank and replay diverges. This
+PR's multi-LoRA trio (``adapters`` / ``max_resident_adapters`` /
+``lora_rank``) is exactly the shape of change this lint exists to
+police, so it doubles as the sanity probe below.
+
+Two directions, both AST (no imports, no construction):
+
+- every ``ServeEngine.__init__`` keyword-only parameter appears as a
+  key in the client's ``engine_kwargs`` literal, and
+- every key in that literal is a real ``ServeEngine.__init__``
+  parameter AND a real ``ServeClient.__init__`` parameter (no phantom
+  or stale keys surviving an engine-side rename).
+"""
+import ast
+import pathlib
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+ENGINE = ROOT / "ray_lightning_tpu" / "serve" / "engine.py"
+CLIENT = ROOT / "ray_lightning_tpu" / "serve" / "client.py"
+
+
+def _init_of(path, cls_name):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for item in node.body:
+                if (isinstance(item, ast.FunctionDef)
+                        and item.name == "__init__"):
+                    return item
+    raise AssertionError(f"{cls_name}.__init__ not found in {path}")
+
+
+def _param_names(fn):
+    args = fn.args
+    return {a.arg for a in args.args + args.kwonlyargs} - {"self"}
+
+
+def _engine_kwargs_literal(fn):
+    """Keys of the ``engine_kwargs = dict(...)`` assignment inside
+    ``ServeClient.__init__`` (keyword form only — a ``**`` splat would
+    defeat the lint, so its appearance fails loudly)."""
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name)
+                        and t.id == "engine_kwargs"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "dict"):
+            assert all(kw.arg is not None for kw in node.value.keywords), \
+                "engine_kwargs uses a **splat — the lint can no longer " \
+                "prove the key set; enumerate the keys explicitly"
+            return {kw.arg for kw in node.value.keywords}
+    raise AssertionError(
+        "ServeClient.__init__ no longer builds an `engine_kwargs = "
+        "dict(...)` literal — update this lint to the new plumbing")
+
+
+ENGINE_INIT = _param_names(_init_of(ENGINE, "ServeEngine")) - {
+    "model", "params"}
+CLIENT_INIT = _param_names(_init_of(CLIENT, "ServeClient"))
+FORWARDED = _engine_kwargs_literal(_init_of(CLIENT, "ServeClient"))
+
+
+def test_lint_sees_the_plumbing():
+    # sanity: the walker finds the shapes it claims to police (a
+    # refactor that renames them must update this lint, not silently
+    # collect nothing)
+    assert {"num_slots", "prefill_len", "tenant_classes"} <= ENGINE_INIT
+    assert {"adapters", "max_resident_adapters", "lora_rank"} \
+        <= ENGINE_INIT  # the PR this lint shipped with
+    assert len(FORWARDED) >= 20
+
+
+def test_every_engine_kwarg_is_forwarded_by_the_client():
+    missing = ENGINE_INIT - FORWARDED
+    assert not missing, (
+        f"ServeEngine.__init__ kwargs {sorted(missing)} never land in "
+        "ServeClient's engine_kwargs literal — a supervised crash or "
+        "fleet replica build would rebuild the engine WITHOUT them and "
+        "replay would silently diverge. Add them to the client "
+        "parameter list and the engine_kwargs dict.")
+
+
+def test_no_phantom_keys_in_the_client_literal():
+    phantom = FORWARDED - ENGINE_INIT
+    assert not phantom, (
+        f"engine_kwargs keys {sorted(phantom)} are not "
+        "ServeEngine.__init__ parameters — stale after an engine-side "
+        "rename? ServeEngine would reject them at build.")
+    unplumbed = FORWARDED - CLIENT_INIT
+    assert not unplumbed, (
+        f"engine_kwargs keys {sorted(unplumbed)} are not "
+        "ServeClient.__init__ parameters — the literal references "
+        "names the client signature no longer binds.")

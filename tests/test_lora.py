@@ -1,0 +1,513 @@
+"""Batched multi-LoRA serving: bank, registry, lifecycle, identity.
+
+The load-bearing assertions are the two ends of the headline contract
+(ISSUE: batched multi-LoRA serving):
+
+- **Mixed-adapter batching is exact.** A mixed-adapter batched engine
+  emits, per request, exactly the tokens of a solo single-adapter
+  engine — greedy AND sampled (the position-indexed key stream is a
+  pure function of no bank state) — and a null-adapter row is
+  bit-identical to an engine with no bank at all. Pinned across
+  dense / paged / page-native engines, int8 weight quantization (with
+  the pallas fused dequant-matmul: the LoRA delta rides OUTSIDE the
+  quantized base matmul), speculative decoding, async dispatch, crash
+  replay, and 3-replica fleet failover.
+- **Residency is deterministic.** Hot load/unload never recompiles
+  (the bank's shape is part of the program); eviction takes the
+  least-recently-bound refcount-0 resident, same sequence → same
+  victim; naming an unloaded/evicted adapter sheds with
+  :class:`UnknownAdapter` exactly like a tenancy quota shed.
+
+Registry and bank-helper tests are pure host work; integration tests
+reuse the session-scoped ``serve_nano_family`` pair at the serve-suite
+pinned shapes (num_slots 2, prefill_len 8) and ONE armed lora config
+(rank 2, bank capacity 2), so armed engines share jit entries.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.lora import (LoraConfig, adapter_bytes,
+                                           extract_adapter, install_adapter,
+                                           install_lora_bank, zero_adapter)
+from ray_lightning_tpu.obs import Telemetry
+from ray_lightning_tpu.reliability import FaultPlan, RetryPolicy
+from ray_lightning_tpu.serve import (AdapterBankFull, AdapterRegistry,
+                                     ReplicaFleet, ServeClient, ServeEngine,
+                                     TenantClass, UnknownAdapter)
+from ray_lightning_tpu.serve.request import OccupancyError
+
+pytestmark = [pytest.mark.serve, pytest.mark.lora]
+
+RANK, CAP = 2, 2
+
+
+def _rand_adapter(params, seed):
+    """A publishable adapter tree with non-trivial weights: graft a
+    1-slot bank, slice it out, and fill it with seeded noise."""
+    bank = install_lora_bank(params, LoraConfig(rank=RANK, num_adapters=1))
+    tree = extract_adapter(bank, 0)
+
+    def rnd(t, key):
+        out = {}
+        for k, v in sorted(t.items()):
+            key, sub = jax.random.split(key)
+            out[k] = (rnd(v, sub) if isinstance(v, dict)
+                      else 0.3 * jax.random.normal(sub, v.shape, v.dtype))
+        return out
+    return rnd(tree, jax.random.PRNGKey(seed))
+
+
+@pytest.fixture(scope="module")
+def lora_env(serve_nano_family):
+    dec, params = serve_nano_family[:2]
+    return dec, params, {"a": _rand_adapter(params, 1),
+                         "b": _rand_adapter(params, 2)}
+
+
+def _client(dec, params, adapters=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("prefill_len", 8)
+    if adapters is not None:
+        kw.update(adapters=adapters, max_resident_adapters=CAP,
+                  lora_rank=RANK)
+    return ServeClient(dec, params, **kw)
+
+
+#: greedy a/b/null rows plus one sampled adapter row — the one mixed
+#: trace every identity test replays (seeds pin the key streams)
+ATRACE = [
+    (0, dict(prompt=[1, 2, 3], max_new_tokens=6, adapter="a", seed=100)),
+    (0, dict(prompt=[2, 2, 3], max_new_tokens=6, adapter="b", seed=101)),
+    (1, dict(prompt=[3, 2, 3], max_new_tokens=6, seed=102)),
+    (2, dict(prompt=[4, 2, 3], max_new_tokens=5, adapter="a",
+             temperature=0.9, seed=103)),
+]
+
+
+def _run(dec, params, trace=ATRACE, adapters=None, **kw):
+    client = _client(dec, params, adapters=adapters, **kw)
+    try:
+        return client.serve_trace(list(trace))
+    finally:
+        client.shutdown()
+
+
+def _solo(dec, params, adapters, entry, **kw):
+    """One request on its own engine (same armed shapes), keyed by the
+    mixed run's seed so the streams coincide."""
+    client = _client(dec, params, adapters=adapters, **kw)
+    try:
+        rid = client.submit(**entry[1])
+        return client.run_until_idle()[rid]
+    finally:
+        client.shutdown()
+
+
+@pytest.fixture(scope="module")
+def dense_base(lora_env):
+    dec, params, ads = lora_env
+    return _run(dec, params, adapters=ads)
+
+
+# --------------------------------------------------------------------- #
+# registry (pure host bookkeeping)
+# --------------------------------------------------------------------- #
+def test_registry_lru_eviction_is_deterministic():
+    reg = AdapterRegistry(2)
+    assert reg.admit("a") == (0, None)
+    assert reg.admit("b") == (1, None)
+    # full bank, both refcount 0: evict the least-recently-bound ("a")
+    idx, evicted = reg.admit("c")
+    assert (idx, evicted) == (0, "a")
+    assert reg.residents == ["b", "c"]
+    # bind touches recency: "b" becomes most recent, so "c" is next out
+    reg.bind("b")
+    reg.unbind("b")
+    assert reg.admit("d") == (0, "c")   # inherits c's slot
+    assert reg.evictions == 2 and reg.loads == 4
+
+
+def test_registry_pinning_blocks_eviction_and_unload():
+    reg = AdapterRegistry(2)
+    reg.admit("a")
+    reg.admit("b")
+    reg.bind("a")
+    reg.bind("b")
+    with pytest.raises(AdapterBankFull) as exc:
+        reg.admit("c")
+    assert exc.value.capacity == 2 and exc.value.pinned == 2
+    with pytest.raises(OccupancyError, match="in-flight"):
+        reg.unload("a")
+    reg.unbind("a")
+    # "a" unpinned: it is the LRU victim now
+    assert reg.admit("c") == (0, "a")
+    with pytest.raises(ValueError, match="without a matching bind"):
+        reg.unbind("a")
+
+
+def test_registry_unknown_adapter_carries_context():
+    reg = AdapterRegistry(1, bytes_per_adapter=10)
+    reg.admit("a")
+    with pytest.raises(UnknownAdapter) as exc:
+        reg.index_of("ghost")
+    err = exc.value
+    assert isinstance(err, ValueError)  # rides the shed/refusal paths
+    assert err.adapter == "ghost" and err.resident == ["a"]
+    assert err.capacity == 1
+    assert reg.resident_bytes() == 10
+    reg.unload("a")
+    assert reg.resident_bytes() == 0 and reg.admit("a") == (0, None)
+
+
+# --------------------------------------------------------------------- #
+# bank helpers (train→serve artifacts)
+# --------------------------------------------------------------------- #
+def test_bank_graft_roundtrip_and_accounting(lora_env):
+    dec, params, ads = lora_env
+    lora = LoraConfig(rank=RANK, num_adapters=CAP)
+    bank = install_lora_bank(params, lora)
+    # grafting adds ONLY lora_* leaves: the base tree rides unchanged
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    flat_bank = {jax.tree_util.keystr(p): l for p, l
+                 in jax.tree_util.tree_leaves_with_path(bank)}
+    for path, leaf in flat:
+        assert flat_bank[jax.tree_util.keystr(path)] is leaf
+    extra = [k for k in flat_bank if "lora" in k]
+    assert extra and all(k.endswith(("lora_A']", "lora_B']"))
+                         for k in extra)
+    # install → extract roundtrip, and zero_adapter wipes the slot
+    bank = install_adapter(bank, ads["a"], 1)
+    got = extract_adapter(bank, 1)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda x, y: jnp.array_equal(x, y), got, ads["a"]))
+    wiped = extract_adapter(zero_adapter(bank, 1), 1)
+    assert all(not leaf.any() for leaf in jax.tree_util.tree_leaves(wiped))
+    # exact per-slot accounting: total bank bytes / capacity
+    total = sum(flat_bank[k].nbytes for k in extra)
+    assert adapter_bytes(bank) == total // CAP
+
+
+def test_bank_helpers_validate_loudly(lora_env):
+    dec, params, ads = lora_env
+    with pytest.raises(ValueError, match="no projection"):
+        install_lora_bank({"w": {"kernel": np.zeros((2, 2))}},
+                          LoraConfig(rank=1))
+    with pytest.raises(ValueError, match="found no lora banks"):
+        extract_adapter(params)
+    bank = install_lora_bank(params, LoraConfig(rank=RANK,
+                                                num_adapters=CAP))
+    with pytest.raises(ValueError, match="out of range"):
+        extract_adapter(bank, CAP)
+    # a rank-3 adapter into a rank-2 bank names the offending path
+    bad = install_lora_bank(params, LoraConfig(rank=3, num_adapters=1))
+    with pytest.raises(ValueError, match="shape mismatch at"):
+        install_adapter(bank, extract_adapter(bad, 0), 0)
+    with pytest.raises(ValueError, match="rank must be >= 1"):
+        LoraConfig(rank=0)
+    with pytest.raises(ValueError, match="unknown lora targets"):
+        LoraConfig(rank=1, targets=("qkv", "bogus"))
+
+
+def test_engine_arming_validation(lora_env):
+    dec, params, ads = lora_env
+    with pytest.raises(ValueError, match="max_resident_adapters"):
+        ServeEngine(dec, params, num_slots=2, prefill_len=8,
+                    adapters={"a": ads["a"]})
+    with pytest.raises(ValueError, match="lora_rank"):
+        ServeEngine(dec, params, num_slots=2, prefill_len=8,
+                    max_resident_adapters=2)
+    with pytest.raises(ValueError, match="max_resident_adapters"):
+        ServeEngine(dec, params, num_slots=2, prefill_len=8,
+                    max_resident_adapters=1, lora_rank=RANK,
+                    adapters=ads)  # 2 adapters > capacity 1
+
+
+# --------------------------------------------------------------------- #
+# mixed-adapter identity (THE headline contract)
+# --------------------------------------------------------------------- #
+def test_mixed_vs_solo_identity_dense(lora_env, dense_base):
+    """Every row of the mixed-adapter batch — greedy a/b rows, a
+    sampled a row, and a null row — emits exactly its solo engine's
+    tokens; the null row matches a bankless engine bit-for-bit; each
+    completion is stamped with the adapter it decoded under."""
+    dec, params, ads = lora_env
+    out = dense_base
+    assert {r: c.adapter for r, c in out.items()} == {
+        0: "a", 1: "b", 2: None, 3: "a"}
+    for rid, entry in enumerate(ATRACE):
+        name = entry[1].get("adapter")
+        solo = _solo(dec, params,
+                     {name: ads[name]} if name else None, entry)
+        assert out[rid].tokens == solo.tokens, rid
+        assert out[rid].finish_reason == solo.finish_reason
+    # the adapters actually bite: adapted rows diverge from base
+    base2 = _solo(dec, params, None,
+                  (0, dict(ATRACE[0][1], adapter=None)))
+    assert out[0].tokens != base2.tokens
+
+
+VARIANTS = [
+    pytest.param(dict(page_size=8, num_pages=16), id="paged"),
+    pytest.param(dict(page_size=8, num_pages=16, page_native=True),
+                 id="page_native"),
+    pytest.param(dict(weight_dtype="int8"), id="int8",
+                 marks=pytest.mark.quant),
+    pytest.param(dict(weight_dtype="int8", matmul_kernel="pallas"),
+                 id="int8_pallas", marks=pytest.mark.matmul),
+]
+
+
+@pytest.mark.parametrize("kw", VARIANTS)
+def test_mixed_vs_solo_identity_variants(lora_env, kw):
+    """The bank composes with every serve storage/kernel lever: paged
+    and page-native KV, int8 weight quantization, and the pallas fused
+    dequant-matmul (the LoRA delta rides OUTSIDE the quantized base
+    matmul, so neither kernel changes)."""
+    dec, params, ads = lora_env
+    out = _run(dec, params, adapters=ads, **kw)
+    for rid in (0, 2, 3):   # greedy a, null, sampled a
+        entry = ATRACE[rid]
+        name = entry[1].get("adapter")
+        solo = _solo(dec, params,
+                     {name: ads[name]} if name else None, entry, **kw)
+        assert out[rid].tokens == solo.tokens, (rid, kw)
+
+
+@pytest.mark.spec
+def test_mixed_vs_solo_identity_speculative(lora_env, serve_nano_family):
+    """Adapter ids reach the TARGET verify program only — the draft
+    stays unadapted (one draft serves every adapter; a mismatched draft
+    costs acceptance rate, never correctness) — and mixed-vs-solo
+    identity holds through the accept/reject rule."""
+    dec, params, ads = lora_env
+    _, _, draft, dparams = serve_nano_family
+    kw = dict(draft_model=draft, draft_params=dparams, spec_k=2)
+    out = _run(dec, params, adapters=ads, **kw)
+    for rid in (0, 2, 3):
+        entry = ATRACE[rid]
+        name = entry[1].get("adapter")
+        solo = _solo(dec, params,
+                     {name: ads[name]} if name else None, entry, **kw)
+        assert out[rid].tokens == solo.tokens, rid
+
+
+@pytest.mark.async_dispatch
+def test_async_dispatch_mixed_identity(lora_env, dense_base):
+    dec, params, ads = lora_env
+    out = _run(dec, params, adapters=ads, async_dispatch=True)
+    for rid in dense_base:
+        assert out[rid].tokens == dense_base[rid].tokens, rid
+        assert out[rid].adapter == dense_base[rid].adapter
+
+
+def test_crash_replay_preserves_adapter_binding(lora_env, dense_base):
+    """A supervised engine crash mid-mixed-trace rebuilds and replays:
+    token streams and adapter stamps are identical to the unfaulted
+    run — the resolved binding rides the request object."""
+    dec, params, ads = lora_env
+    for ticks in ([1], [3]):
+        plan = FaultPlan.at("serve.dispatch", ticks)
+        client = _client(dec, params, adapters=ads,
+                         retry_policy=RetryPolicy(max_attempts=3,
+                                                  base_delay=0.0))
+        try:
+            with plan.armed():
+                out = client.serve_trace(list(ATRACE))
+        finally:
+            client.shutdown()
+        assert plan.fired == len(ticks)
+        for rid in dense_base:
+            assert out[rid].tokens == dense_base[rid].tokens, (ticks, rid)
+            assert out[rid].adapter == dense_base[rid].adapter
+
+
+def test_supervised_hot_load_survives_crash(lora_env, dense_base):
+    """A hot load through the supervisor syncs the rebuild kwargs: an
+    engine crash AFTER the load rebuilds with the same resident set, so
+    replayed requests bound to the hot-loaded adapter re-bind instead
+    of shedding as UnknownAdapter."""
+    dec, params, ads = lora_env
+    client = _client(dec, params, adapters={"a": ads["a"]},
+                     retry_policy=RetryPolicy(max_attempts=3,
+                                              base_delay=0.0))
+    try:
+        assert client.load_adapter("b", ads["b"]) is None
+        plan = FaultPlan.at("serve.dispatch", [1])
+        with plan.armed():
+            out = client.serve_trace(list(ATRACE))
+    finally:
+        client.shutdown()
+    assert plan.fired == 1
+    for rid in dense_base:
+        assert out[rid].tokens == dense_base[rid].tokens, rid
+
+
+# --------------------------------------------------------------------- #
+# hot load / unload / eviction
+# --------------------------------------------------------------------- #
+def test_hot_load_eviction_and_refusal(lora_env):
+    """Loading past capacity evicts the least-recently-bound unpinned
+    resident (deterministic), the evictee's future submits shed with
+    UnknownAdapter, and post-eviction tokens are solo-identical — the
+    slot write left no residue."""
+    dec, params, ads = lora_env
+    client = _client(dec, params, adapters={"a": ads["a"]})
+    try:
+        assert client.load_adapter("b", ads["b"]) is None
+        assert client.load_adapter("c", ads["a"]) == "a"   # LRU victim
+        assert client.engine.resident_adapters == ["b", "c"]
+        with pytest.raises(UnknownAdapter) as exc:
+            client.submit([1, 2, 3], max_new_tokens=4, adapter="a")
+        assert exc.value.resident == ["b", "c"]
+        # "c" carries adA's weights: its stream IS the solo-a stream
+        rid = client.submit(**dict(ATRACE[0][1], adapter="c"))
+        out = client.run_until_idle()[rid]
+        client.unload_adapter("c")
+        assert client.engine.resident_adapters == ["b"]
+        with pytest.raises(OccupancyError, match="no adapter bank|not "
+                           "resident"):
+            client.unload_adapter("c")
+    finally:
+        client.shutdown()
+    solo = _solo(dec, params, {"a": ads["a"]}, ATRACE[0])
+    assert out.tokens == solo.tokens
+
+
+def test_unknown_adapter_sheds_in_trace(lora_env):
+    """A trace entry naming an unloaded adapter is SHED as a rejected
+    completion (stamped with the refused name) — the replay keeps
+    serving everything else, exactly like a tenancy quota shed."""
+    dec, params, ads = lora_env
+    out = _run(dec, params,
+               trace=ATRACE + [(3, dict(prompt=[5, 2, 3],
+                                        max_new_tokens=4,
+                                        adapter="ghost", seed=109))],
+               adapters=ads)
+    shed = out[len(ATRACE)]
+    assert shed.finish_reason == "rejected" and shed.adapter == "ghost"
+    assert all(out[r].finish_reason in ("eos", "length")
+               for r in range(len(ATRACE)))
+
+
+def test_tenant_default_adapter_binding(lora_env, dense_base):
+    """``TenantClass.adapter=`` is the class default: resolved at
+    engine admission, stamped onto the completion; an explicit
+    per-request adapter wins over it."""
+    dec, params, ads = lora_env
+    classes = [TenantClass(name="tuned", adapter="a")]
+    client = _client(dec, params, adapters=ads, tenant_classes=classes)
+    try:
+        rid = client.submit(prompt=[1, 2, 3], max_new_tokens=6,
+                            tenant="tuned", seed=100)
+        rid_b = client.submit(prompt=[2, 2, 3], max_new_tokens=6,
+                              tenant="tuned", adapter="b", seed=101)
+        out = client.run_until_idle()
+    finally:
+        client.shutdown()
+    assert out[rid].adapter == "a"
+    assert out[rid].tokens == dense_base[0].tokens
+    assert out[rid_b].adapter == "b"
+    assert out[rid_b].tokens == dense_base[1].tokens
+
+
+# --------------------------------------------------------------------- #
+# fleet
+# --------------------------------------------------------------------- #
+@pytest.mark.fleet
+def test_fleet_failover_preserves_adapters(lora_env, dense_base):
+    """Killing a replica mid-mixed-trace re-admits its work to
+    survivors: every stream token-identical to the unfaulted single
+    client, every completion keeping its adapter stamp."""
+    dec, params, ads = lora_env
+    fkw = dict(num_replicas=3, num_slots=2, prefill_len=8,
+               adapters=ads, max_resident_adapters=CAP, lora_rank=RANK)
+    plan = FaultPlan.at("serve.replica", [3])
+    fleet = ReplicaFleet(dec, params, **fkw)
+    try:
+        with plan.armed():
+            out = fleet.serve_trace(list(ATRACE))
+        assert plan.fired == 1 and fleet.failovers == 1
+    finally:
+        fleet.shutdown()
+    for rid in dense_base:
+        assert out[rid].tokens == dense_base[rid].tokens, rid
+        assert out[rid].adapter == dense_base[rid].adapter
+
+
+@pytest.mark.fleet
+def test_fleet_hot_load_lockstep_and_eviction(lora_env, dense_base):
+    """Fleet-wide hot load/unload keeps every replica's resident set in
+    lockstep; a full bank evicts ONE fleet-chosen victim (the oldest
+    fleet-level load) everywhere, and the evictee sheds fleet-wide."""
+    dec, params, ads = lora_env
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_slots=2,
+                         prefill_len=8, adapters={"a": ads["a"]},
+                         max_resident_adapters=CAP, lora_rank=RANK)
+    try:
+        assert fleet.load_adapter("b", ads["b"]) is None
+        assert fleet.load_adapter("c", ads["a"]) == "a"
+        for rep in fleet._replicas:
+            assert rep.client.engine.resident_adapters == ["b", "c"]
+        rid = fleet.submit(**dict(ATRACE[0][1], adapter="c"))
+        out = fleet.run_until_idle()
+        with pytest.raises(UnknownAdapter):
+            fleet.submit([1, 2, 3], max_new_tokens=4, adapter="a")
+        fleet.unload_adapter("b")
+        for rep in fleet._replicas:
+            assert rep.client.engine.resident_adapters == ["c"]
+    finally:
+        fleet.shutdown()
+    assert out[rid].tokens == dense_base[0].tokens
+
+
+# --------------------------------------------------------------------- #
+# observability + accounting
+# --------------------------------------------------------------------- #
+def test_adapter_events_gauge_and_byte_accounting(lora_env):
+    dec, params, ads = lora_env
+    tel = Telemetry()
+    client = _client(dec, params, adapters={"a": ads["a"]},
+                     telemetry=tel)
+    try:
+        eng = client.engine
+        per = eng._registry.bytes_per_adapter
+        assert per == adapter_bytes(eng.params) > 0
+        assert eng.adapter_bank_bytes() == CAP * per
+        assert eng.occupancy()["resident_adapters"] == 1
+        client.load_adapter("b", ads["b"])
+        client.load_adapter("c", ads["a"])       # evicts "a"
+        rid = client.submit([1, 2, 3], max_new_tokens=4, adapter="c")
+        client.run_until_idle()
+        client.unload_adapter("b")
+    finally:
+        client.shutdown()
+    sites = [e.site for e in tel.events()]
+    assert sites.count("engine.adapter_loaded") == 3  # init + 2 hot
+    assert "engine.adapter_evicted" in sites
+    assert "engine.adapter_unloaded" in sites
+    bound = tel.events("engine.adapter_bound")
+    assert [e.payload["adapter"] for e in bound] == ["c"]
+    assert bound[0].payload["id"] == rid
+    snap = tel.metrics.snapshot()
+    assert snap["serve_adapter_resident"] == 1.0  # after the unload
+    assert snap["serve_adapter_requests_total_c"] == 1
+
+
+def test_disarmed_engine_has_no_adapter_surface(lora_env, dense_base):
+    """``telemetry=None`` + no bank: the disarmed engine allocates no
+    registry, emits nothing, and refuses adapter ops loudly."""
+    dec, params, _ads = lora_env
+    client = _client(dec, params)
+    try:
+        assert client.engine._registry is None
+        assert client.engine.resident_adapters == []
+        assert client.engine.adapter_bank_bytes() == 0
+        assert client.engine.occupancy()["resident_adapters"] is None
+        with pytest.raises(ValueError, match="no adapter bank"):
+            client.load_adapter("a", {})
+        with pytest.raises(UnknownAdapter):
+            client.submit([1, 2, 3], max_new_tokens=4, adapter="a")
+    finally:
+        client.shutdown()
