@@ -2727,6 +2727,116 @@ def _bench_chaos(num_slots: int = 4, n_requests: int = 8,
     }
 
 
+def _bench_chaos_poison(num_replicas: int = 3, n_requests: int = 9,
+                        prompt: int = 32, new_tokens: int = 24,
+                        steps_per_dispatch: int = 4,
+                        max_request_failovers: int = 3) -> dict:
+    """Poison containment under load: bounded blast radius, innocents
+    exact (PR 18).
+
+    A ``num_replicas`` in-process :class:`ReplicaFleet` (GPT-2-small,
+    **fp32** — innocents must be checkable token-for-token, the
+    ``_bench_fleet`` rule) serves a pinned mixed trace twice: once
+    clean, once with one request turned into a poison pill
+    (``FaultPlan(poison=...)`` — it kills every engine that seats it,
+    every time). Containment is ENFORCED, not just recorded: the poison
+    must retire ``finish_reason="failed"`` having consumed at most
+    ``max_request_failovers`` replica kills, and every innocent request
+    must finish with **zero** token mismatches against the clean run —
+    a violation raises :class:`MeasurementError` because every other
+    number in ``extras["chaos"]`` presumes recovery works.
+
+    ``extras["chaos"]["poison"]``: ``poison_tokens_per_sec`` (innocent
+    tokens only, under containment), ``containment_slowdown`` vs clean,
+    ``replicas_lost`` (== failovers consumed by containment), and the
+    enforced invariants echoed as numbers."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.reliability import FaultPlan
+    from ray_lightning_tpu.serve import (FINISH_FAILED, FleetConfig,
+                                         ReplicaFleet)
+
+    total = prompt + new_tokens
+    num_slots = 4  # per replica
+    base = dict(vocab_size=50304, max_seq_len=total, dtype=jnp.float32,
+                scan_layers=False)
+    model = TransformerLM(gpt2_config("small", **base))
+    toks0 = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50257, size=(num_slots, prompt)), jnp.int32)
+    params = jax.device_put(
+        model.init(jax.random.PRNGKey(0), toks0)["params"])
+    dec = TransformerLM(gpt2_config("small", decode=True, **base))
+
+    rng = np.random.default_rng(18)
+    trace = []
+    for i in range(n_requests):
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        trace.append((0.02 * i, dict(
+            prompt=[int(t) for t in rng.integers(0, 50257, size=L)],
+            max_new_tokens=int(rng.integers(new_tokens // 2,
+                                            new_tokens + 1)))))
+    poison_id = n_requests // 2  # mid-trace: lands on a warm fleet
+
+    kw = dict(num_slots=num_slots, prefill_len=total,
+              steps_per_dispatch=steps_per_dispatch)
+    cfg = FleetConfig(max_request_failovers=max_request_failovers,
+                      probation_after=2)
+
+    def run_fleet(plan=None):
+        fleet = ReplicaFleet(dec, params, num_replicas=num_replicas,
+                             num_standby=1, clock=time.perf_counter,
+                             fleet_config=cfg, **kw)
+        if plan is None:
+            out = fleet.serve_trace(trace)
+        else:
+            with plan.armed():
+                out = fleet.serve_trace(trace)
+        makespan = max(c.finish_time for c in out.values())
+        fleet.shutdown()
+        return fleet, out, makespan
+
+    run_fleet()  # warmup: compiles prefill+inject and the K-step program
+    _, clean_out, clean_makespan = run_fleet()
+
+    fleet, out, makespan = run_fleet(FaultPlan(poison=(poison_id,)))
+    if out[poison_id].finish_reason != FINISH_FAILED \
+            or fleet.poison_failed != 1:
+        raise MeasurementError(
+            f"poison request {poison_id} finished "
+            f"{out[poison_id].finish_reason!r} (poison_failed="
+            f"{fleet.poison_failed}) — containment never retired it")
+    if fleet.failovers > max_request_failovers:
+        raise MeasurementError(
+            f"poison consumed {fleet.failovers} replicas, budget is "
+            f"{max_request_failovers} — the failover budget leaked")
+    innocents = [rid for rid in clean_out if rid != poison_id]
+    mismatched = sum(1 for rid in innocents
+                     if out[rid].tokens != clean_out[rid].tokens)
+    failed = sum(1 for rid in innocents
+                 if out[rid].finish_reason == FINISH_FAILED)
+    if failed or mismatched:
+        raise MeasurementError(
+            f"containment harmed innocents ({failed} failed, "
+            f"{mismatched}/{len(innocents)} diverged in fp32) — "
+            "isolation is broken, timing numbers would be meaningless")
+
+    innocent_tokens = sum(len(out[rid].tokens) for rid in innocents)
+    return {
+        "model": "gpt2_small (fp32 serving params)",
+        "replicas": num_replicas, "slots_per_replica": num_slots,
+        "requests": n_requests, "poison_id": poison_id,
+        "max_request_failovers": max_request_failovers,
+        "replicas_lost": fleet.failovers,
+        "poison_failed": fleet.poison_failed,
+        "innocent_token_mismatches": mismatched,
+        "poison_tokens_per_sec": round(innocent_tokens / makespan, 0),
+        "containment_slowdown": round(makespan / clean_makespan, 2),
+    }
+
+
 def _bench_fleet(num_replicas: int = 3, n_requests: int = 12,
                  prompt: int = 32, new_tokens: int = 32,
                  steps_per_dispatch: int = 4) -> dict:
@@ -3850,6 +3960,16 @@ def main() -> None:
                 extras["serve"]["spec"]["spec_verify_recovery_ms"]
     except Exception:  # tl-lint: allow-broad-except — mirror only
         pass
+    try:
+        # PR 18 containment leg: a seeded poison pill in a 3-replica
+        # mixed trace. ENFORCED — the poison must retire failed within
+        # its failover budget with innocents token-exact (fp32), or the
+        # leg raises MeasurementError.
+        if isinstance(extras.get("chaos"), dict):
+            extras["chaos"]["poison"] = _bench_chaos_poison()
+    except Exception as exc:
+        extras["chaos"]["poison"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
     try:
         # replica-fleet serving under a seeded serve.replica kill:
         # failover cost + fleet-vs-single-engine throughput, untracked.

@@ -33,10 +33,15 @@ one channel):
   forwarding: events and metric updates re-emitted verbatim into the
   driver's Telemetry by the fleet (per-replica gauges keep their
   ``replica<id>_`` prefix, stamped worker-side).
-- ``(MSG_CRASH, replica_id, "ExcType: detail")`` — the dispatch loop
-  raised; the engine state is unknown and the driver fails the replica
-  over (``replica.error`` unless the process also died — the ``_dead``
-  latch is consulted FIRST, see ``process_fleet._classify_failure``).
+- ``(MSG_CRASH, replica_id, "ExcType: detail", implicated_ids)`` — the
+  dispatch loop raised; the engine state is unknown and the driver
+  fails the replica over (``replica.error`` unless the process also
+  died — the ``_dead`` latch is consulted FIRST, see
+  ``process_fleet._classify_failure``). ``implicated_ids`` is the
+  engine-resident request-id set at crash time (``None`` if even that
+  enumeration failed) — the failure-containment layer's exact
+  implication set; messageless deaths (kill -9) implicate every
+  displaced request conservatively instead.
 
 Heartbeats do NOT ride the out-queue: the fleet clock rides the
 dedicated heartbeat channel via the gang layer's
@@ -188,8 +193,16 @@ class ServeReplicaWorker:
     def __init__(self, model: Any, params: Any, engine_kwargs: Dict,
                  out_queue: Any, heartbeat_channel: Any,
                  epoch: float, poll_s: float = 0.002,
-                 heartbeat_interval: float = 0.02):
+                 heartbeat_interval: float = 0.02,
+                 fault_plan: Any = None):
         from ray_lightning_tpu.serve.client import ServeClient
+        if fault_plan is not None:
+            # the driver's armed FaultPlan crosses the construct pickle
+            # so worker-side engines fire the same sites (chaos drills
+            # and the bench's poison leg hold on this backend); arming
+            # here is per-process — it cannot leak into other workers
+            from ray_lightning_tpu.reliability import faults
+            faults.ensure_armed(fault_plan)
         self._out = out_queue
         self._hb_channel = heartbeat_channel
         self._poll_s = float(poll_s)
@@ -309,7 +322,8 @@ class ServeReplicaWorker:
                     self._crashed = True
                     self._buf.append(
                         (MSG_CRASH, self._id,
-                         f"{type(exc).__name__}: {exc}"))
+                         f"{type(exc).__name__}: {exc}",
+                         self._implicated()))
                     self._flush()
                     return  # engine state unknown: stop driving; the
                     #         driver kills this replica and replays
@@ -323,6 +337,21 @@ class ServeReplicaWorker:
         # not die in the buffer
         with self._lock:
             self._flush()
+
+    def _implicated(self) -> Optional[List[int]]:
+        """Request ids in the engine when the dispatch loop crashed —
+        the driver's exact-implication set (MSG_CRASH 4th field). A
+        dispatch crash leaves every engine-resident request co-batched
+        with the failure: active decode rows plus the chunked-prefill
+        queue. Best-effort: an engine too broken to enumerate returns
+        None and the driver falls back to implicating all displaced."""
+        try:
+            eng = self.client.engine
+            ids = {int(r.id) for r in eng.active_requests.values()}
+            ids.update(int(st.request.id) for st in eng._chunk_queue)
+            return sorted(ids)
+        except Exception:  # tl-lint: allow-broad-except — best-effort enumeration of a crashed engine; must not mask the original crash
+            return None
 
     def _collect_progress(self) -> None:
         """Ship cumulative emitted tokens for streams that advanced —

@@ -41,6 +41,18 @@ Sites are woven into the hot paths as a single ``fire(site)`` call:
                       wedges it (deadline pressure on every in-flight
                       row). Only fires on engines armed with a
                       ``draft_model``.
+``serve.poison``      id-triggered, not tick-scheduled: the engine calls
+                      ``poison_check(requests)`` after seating a prefill
+                      batch and before every decode dispatch; the plan's
+                      ``poison`` id set crashes any dispatch a scheduled
+                      request id joins, every time — the deterministic
+                      "poison input" that kills whatever replica admits
+                      it (vs ``serve.dispatch``'s transient nth-tick
+                      crash). ``mode="exit"`` hard-kills a spawned
+                      replica process (the kill -9 shape); degrades to
+                      ``raise`` in-process. Exercises the fleet's
+                      failure-containment layer
+                      (``docs/reliability.md#failure-containment``).
 ====================  ====================================================
 
 The worker sites additionally carry the firing worker's **rank**
@@ -83,6 +95,7 @@ SITE_WORKER_STALL = "worker.stall"
 SITE_RENDEZVOUS_INIT = "rendezvous.init"
 SITE_SERVE_REPLICA = "serve.replica"
 SITE_SERVE_VERIFY = "serve.verify"
+SITE_SERVE_POISON = "serve.poison"
 
 MODE_RAISE = "raise"
 MODE_NAN = "nan"
@@ -104,6 +117,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     SITE_RENDEZVOUS_INIT: (MODE_RAISE, MODE_STALL),
     SITE_SERVE_REPLICA: (MODE_RAISE, MODE_STALL),
     SITE_SERVE_VERIFY: (MODE_RAISE, MODE_STALL),
+    SITE_SERVE_POISON: (MODE_RAISE, MODE_EXIT),
 }
 
 
@@ -165,9 +179,21 @@ class FaultPlan:
     """
 
     def __init__(self, specs: Iterable[FaultSpec] = (),
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 poison: Iterable[int] = (),
+                 poison_mode: str = MODE_RAISE):
         self.specs: List[FaultSpec] = list(specs)
         self._sleep = sleep  # injectable: stall tests stay wall-clock-free
+        # id-triggered poison (SITE_SERVE_POISON): request ids whose
+        # presence in a seated batch crashes the dispatch, every time —
+        # deterministic by id, not by tick, so the same input kills
+        # whichever replica re-admits it after failover.
+        self.poison = frozenset(int(i) for i in poison)
+        if poison_mode not in SITES[SITE_SERVE_POISON]:
+            raise ValueError(
+                f"poison_mode {poison_mode!r} not supported "
+                f"(supported: {SITES[SITE_SERVE_POISON]})")
+        self.poison_mode = poison_mode
         self._by_key: Dict[Tuple[str, int, Optional[int]], FaultSpec] = {}
         for spec in self.specs:
             key = (spec.site, spec.at, spec.rank)
@@ -281,6 +307,49 @@ class FaultPlan:
             self._sleep(spec.stall_s)
         return spec.mode
 
+    def poison_check(self, requests: Iterable) -> None:
+        """Crash iff any of ``requests`` is a scheduled poison id.
+
+        ``requests`` may hold Request objects (matched on ``.id``) or
+        bare ids — engines pass whatever container the call site already
+        holds (``active_requests`` keys, a seated batch, one chunk
+        state's request). Unlike :meth:`fire`, the poison site has no
+        tick schedule: a hit fires *every* time the id is present, which
+        is what makes it a deterministic poison rather than a transient
+        fault. The tick recorded on the :class:`InjectedFault` is the
+        running hit count (for logs/events only).
+        """
+        if not self.poison:
+            return
+        hit = None
+        for r in requests:
+            rid = getattr(r, "id", r)
+            if rid in self.poison:
+                hit = rid
+                break
+        if hit is None:
+            return
+        tick = self._counts[SITE_SERVE_POISON]
+        self._counts[SITE_SERVE_POISON] = tick + 1
+        self.fired += 1
+        logger.warning("injecting poison crash: request %d present "
+                       "(hit %d, mode %s)", hit, tick, self.poison_mode)
+        from ray_lightning_tpu import obs
+        obs.emit_global("fault.injected", site=SITE_SERVE_POISON,
+                        tick=tick, mode=self.poison_mode, request=hit)
+        tel = obs.get_global()
+        if tel is not None:
+            tel.metrics.counter(
+                "reliability_faults_total",
+                help="faults injected by the armed FaultPlan").inc()
+        if self.poison_mode == MODE_EXIT:
+            if os.environ.get(WORKER_PROCESS_ENV):
+                os._exit(17)
+            logger.warning(
+                "poison exit fired outside a spawned worker process; "
+                "degrading to raise so in-process backends survive")
+        raise InjectedFault(SITE_SERVE_POISON, tick)
+
     # ------------------------------------------------------------ arming
     def armed(self):
         """Context manager: install this plan as the process-global one."""
@@ -347,3 +416,12 @@ def fire(site: str, rank: Optional[int] = None) -> Optional[str]:
     if plan is None:
         return None
     return plan.fire(site, rank)
+
+
+def poison_check(requests: Iterable) -> None:
+    """Hot-path hook for :data:`SITE_SERVE_POISON`: no-op (one global
+    read + an empty-set check) unless an armed plan carries poison ids."""
+    plan = _ACTIVE
+    if plan is None or not plan.poison:
+        return
+    plan.poison_check(requests)

@@ -76,14 +76,23 @@ class RetryPolicy:
             raise ValueError(
                 f"deadline must be > 0, got {self.deadline}")
 
-    def delay(self, attempt: int) -> float:
-        """Backoff (seconds) before retry ``attempt`` (1-based)."""
+    def delay(self, attempt: int, salt: int = 0) -> float:
+        """Backoff (seconds) before retry ``attempt`` (1-based).
+
+        ``salt`` folds an extra coordinate into the jitter hash — e.g. a
+        replica seat id, so every quarantined seat sharing one fleet
+        policy backs off on its own de-correlated schedule. The result
+        stays a pure function of ``(seed, salt, attempt)``: replayable,
+        and tests still assert exact schedules per salt.
+        """
         if attempt < 1:
             raise ValueError(f"attempt is 1-based, got {attempt}")
         d = min(self.max_delay,
                 self.base_delay * self.multiplier ** (attempt - 1))
         if self.jitter:
-            d *= 1.0 + self.jitter * (2.0 * _unit(self.seed, attempt) - 1.0)
+            seed = ((self.seed + salt * 0xD1B54A32D192ED03) & _M64
+                    if salt else self.seed)
+            d *= 1.0 + self.jitter * (2.0 * _unit(seed, attempt) - 1.0)
         return d
 
 

@@ -14,11 +14,12 @@ from ray_lightning_tpu.serve.adapters import (AdapterBankFull,
                                               AdapterRegistry,
                                               UnknownAdapter)
 from ray_lightning_tpu.serve.client import ServeClient
+from ray_lightning_tpu.serve.containment import SeatTable
 from ray_lightning_tpu.serve.engine import (KVSlotPool, PendingDispatch,
                                             ServeEngine, SlotPoolFull)
-from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetSaturated,
-                                           ReplicaFleet, Router,
-                                           RouterConfig)
+from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetDegraded,
+                                           FleetSaturated, ReplicaFleet,
+                                           Router, RouterConfig)
 from ray_lightning_tpu.serve.pages import PagePool, PrefixCache
 from ray_lightning_tpu.serve.process_fleet import ProcessReplicaFleet
 from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
@@ -39,6 +40,7 @@ __all__ = [
     "FifoScheduler", "QueueFull", "SchedulerConfig", "ReplicaFleet",
     "ProcessReplicaFleet",
     "Router", "RouterConfig", "FleetConfig", "FleetSaturated",
+    "FleetDegraded", "SeatTable",
     "TenantClass", "TenantScheduler", "ClassQueueFull", "DEFAULT_TENANT",
     "AdapterRegistry", "AdapterBankFull", "UnknownAdapter",
     "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",

@@ -1491,6 +1491,13 @@ class ServeEngine:
             for _ in range(n_chunked):
                 self._chunk_queue.pop()
             raise
+        # poison fires AFTER the batch is seated (not with the dispatch
+        # fire above, which precedes slot acquisition): a poison crash
+        # must leave its request in-flight so snapshot_in_flight() —
+        # and therefore the fleet's implication ledger — sees it.
+        # Crashing pre-admission would bounce the poison back to the
+        # client queue forever, invisible to containment.
+        faults.poison_check(requests)
         # stats/telemetry only once the whole batch's admission held —
         # rolled-back admissions never count as hits or misses
         for eligible, adopted, req in adoptions:
@@ -1559,6 +1566,7 @@ class ServeEngine:
         self._require_synced("prefill_chunk_step")
         faults.fire("serve.dispatch")
         st = self._chunk_queue[0]
+        faults.poison_check((st.request,))
         req = st.request
         C = self.prefill_chunk
         L = len(st.fed)
@@ -1696,6 +1704,7 @@ class ServeEngine:
         if self.spec is not None:
             return self._spec_enqueue(asynchronous)
         faults.fire("serve.dispatch")
+        faults.poison_check(self.pool.active.values())
         tel = self._tel
         cur, pos, active, remaining, stepno = self._carry_in()
         with (tel.span("engine.step", active=int(self._active.sum()))
@@ -1864,6 +1873,7 @@ class ServeEngine:
         (:meth:`_sync_spec`) is shared shape-for-shape with
         :meth:`step_sync` at (rounds, k+1)-token granularity."""
         faults.fire("serve.dispatch")
+        faults.poison_check(self.pool.active.values())
         spec = self.spec
         active_req = self.pool.active
         for slot in spec.stale:

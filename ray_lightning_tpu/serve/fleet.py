@@ -74,13 +74,15 @@ from ray_lightning_tpu.reliability.faults import (InjectedFault, MODE_STALL,
 # serve package → this module → gang → supervisor) when the first import
 # of the repo enters through the reliability package.
 from ray_lightning_tpu.serve.client import ServeClient
+from ray_lightning_tpu.serve.containment import SeatTable
 from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
                                              FINISH_REJECTED,
+                                             FINISH_TIMEOUT,
                                              OccupancyError, Request)
 from ray_lightning_tpu.serve.scheduler import ACTION_IDLE, QueueFull
 
 __all__ = ["ReplicaFleet", "Router", "RouterConfig", "FleetConfig",
-           "FleetSaturated"]
+           "FleetSaturated", "FleetDegraded"]
 
 #: fleet telemetry sites (docs/observability.md)
 EVENT_ROUTE = "fleet.route"
@@ -90,12 +92,22 @@ EVENT_REPLICA_PROMOTED = "fleet.replica_promoted"
 EVENT_SCALE_OUT = "fleet.scale_out"
 EVENT_REPLICA_DRAINING = "fleet.replica_draining"
 EVENT_SCALE_IN = "fleet.scale_in"
+# failure containment (docs/reliability.md#failure-containment)
+EVENT_DEGRADED = "fleet.degraded"
+EVENT_RESTORED = "fleet.restored"
+EVENT_QUARANTINE = "fleet.quarantine"
+EVENT_PROBATION = "fleet.probation"
+EVENT_PROBATION_CLEARED = "fleet.probation_cleared"
+EVENT_POISON_FAILED = "fleet.poison_failed"
+EVENT_READMIT_PARKED = "fleet.readmit_parked"
 
 GAUGE_REPLICAS_LIVE = "serve_fleet_replicas_live"
 GAUGE_QUEUE_DEPTH = "serve_fleet_queue_depth"
+GAUGE_QUARANTINED = "serve_fleet_quarantined"
 COUNTER_FAILOVERS = "serve_fleet_failovers_total"
 COUNTER_READMITTED = "serve_fleet_readmitted_requests_total"
 COUNTER_SHED = "serve_fleet_shed_total"
+COUNTER_POISON_FAILED = "serve_fleet_poison_failed_total"
 HISTOGRAM_ROUTER_LOAD = "serve_fleet_router_load"
 
 
@@ -125,6 +137,25 @@ class FleetSaturated(QueueFull):
                                 oldest_age=oldest_age, replicas=replicas,
                                 class_depths=class_depths,
                                 class_oldest=class_oldest)
+
+
+class FleetDegraded(FleetSaturated):
+    """Shed while the fleet is *degraded*: quarantined seats hold it
+    below ``min_replicas`` and the survivors' admission control said no.
+
+    A subclass of :class:`FleetSaturated` so every existing shed path
+    (``serve_trace``'s ``QueueFull`` catch, caller backoff) handles it
+    unchanged — the distinct type is the operator signal that capacity
+    is gone to quarantine, not to load: retrying harder will not help
+    until a backoff elapses. Carries ``quarantined`` (gated seats) and
+    ``live`` (surviving replicas) on top of the saturation context.
+    """
+
+    def __init__(self, message: str, *, quarantined: Optional[int] = None,
+                 live: Optional[int] = None, **ctx):
+        super().__init__(message, **ctx)
+        self.quarantined = quarantined
+        self.live = live
 
 
 @dataclasses.dataclass(frozen=True)
@@ -322,6 +353,30 @@ class FleetConfig:
     ``min_replicas``. ``min_replicas`` is also the failover floor: a
     failover that would drop the fleet below it cold-builds a
     replacement even with the standby pool empty.
+
+    Failure containment (all OFF by default — a default config is
+    decision-for-decision identical to a pre-containment fleet; see
+    docs/reliability.md#failure-containment):
+
+    ``max_request_failovers``: per-request failover budget. Every
+    replica death implicates its co-batched in-flight requests
+    (``Request.crash_implications``); a request re-admitting at the
+    budget retires ``failed`` with its partial tokens instead of
+    consuming another replica. Setting it also arms **probation**:
+    a request implicated ``probation_after``+ times re-admits solo on a
+    router-excluded replica, so a poison request stops taking innocent
+    batchmates down with it — a clean probation run resets the count.
+
+    ``flap_window`` / ``flap_threshold``: replica crash-loop
+    quarantine. A seat accumulating ``flap_threshold`` deaths inside a
+    sliding ``flap_window`` (fleet clock units) quarantines: catch-up
+    rebuilds into it follow ``quarantine_backoff`` (a
+    :class:`~ray_lightning_tpu.reliability.RetryPolicy`; a
+    deterministic-jitter default when None) instead of hot-looping
+    build→die→build. While quarantine holds the fleet below
+    ``min_replicas`` it is *degraded*: survivors keep serving, sheds
+    raise :class:`FleetDegraded`, and ``fleet.degraded`` /
+    ``fleet.restored`` bracket the episode.
     """
     heartbeat_timeout: float = 8.0
     startup_grace: Optional[float] = None
@@ -331,6 +386,11 @@ class FleetConfig:
     scale_out_queue_depth: float = 4.0
     ttft_slo: Optional[float] = None
     hysteresis: int = 3
+    max_request_failovers: Optional[int] = None
+    probation_after: int = 2
+    flap_window: Optional[float] = None
+    flap_threshold: int = 3
+    quarantine_backoff: Optional[Any] = None  # RetryPolicy
 
     def __post_init__(self):
         if self.heartbeat_timeout <= 0:
@@ -347,6 +407,27 @@ class FleetConfig:
         if self.hysteresis < 1:
             raise ValueError(
                 f"hysteresis must be >= 1, got {self.hysteresis}")
+        if (self.max_request_failovers is not None
+                and self.max_request_failovers < 1):
+            raise ValueError(
+                f"max_request_failovers must be >= 1 or None, got "
+                f"{self.max_request_failovers}")
+        if self.probation_after < 1:
+            raise ValueError(
+                f"probation_after must be >= 1, got "
+                f"{self.probation_after}")
+        if self.flap_window is not None and self.flap_window <= 0:
+            raise ValueError(
+                f"flap_window must be > 0 or None, got "
+                f"{self.flap_window}")
+        if self.flap_threshold < 1:
+            raise ValueError(
+                f"flap_threshold must be >= 1, got {self.flap_threshold}")
+        if (self.quarantine_backoff is not None
+                and self.flap_window is None):
+            raise ValueError(
+                "quarantine_backoff requires flap_window (the sliding "
+                "death window is what arms quarantine)")
 
 
 class _Replica:
@@ -539,6 +620,28 @@ class ReplicaFleet:
         self.scale_ins = 0
         self.failover_s_total = 0.0
 
+        # failure containment (docs/reliability.md#failure-containment).
+        # All state below is inert under a default config: nothing
+        # reads crash_implications without max_request_failovers, the
+        # parked list only fills where the old code insta-failed, and
+        # the seat table is None without flap_window.
+        self.poison_failed = 0
+        self._parked: List[Request] = []
+        self._probation: List[Request] = []
+        self._probation_rep: Optional[int] = None
+        self._probation_obj: Optional[Request] = None
+        self._degraded = False
+        self._seats: Optional[SeatTable] = None
+        if self._cfg.flap_window is not None:
+            from ray_lightning_tpu.reliability.retry import RetryPolicy
+            policy = self._cfg.quarantine_backoff or RetryPolicy(
+                max_attempts=8, base_delay=1.0, max_delay=60.0,
+                multiplier=2.0, jitter=0.1)
+            self._seats = SeatTable(self._cfg.flap_window,
+                                    self._cfg.flap_threshold, policy)
+            for rep in self._replicas:
+                self._seats.occupy(rep.id, self.now(), grow=True)
+
     # ------------------------------------------------------------ clock
     @property
     def ops(self) -> int:
@@ -626,6 +729,10 @@ class ReplicaFleet:
         replica whose admission control accepts wins. Raises
         :class:`FleetSaturated` (aggregated context) when all refuse."""
         ranked = self.router.order(self._replicas, req)
+        if self._probation_rep is not None:
+            # the probation replica is reserved for its solo suspect —
+            # regular traffic routes around it until the run clears
+            ranked = [r for r in ranked if r.id != self._probation_rep]
         affine_target = self.router.affine_target(req)
         for rep in ranked:
             load = self.router.load(rep)
@@ -655,6 +762,17 @@ class ReplicaFleet:
                 class_depths[name] = class_depths.get(name, 0) + depth
             for name, age in sched.class_oldest(now).items():
                 class_oldest[name] = max(class_oldest.get(name, age), age)
+        if self._degraded and self._seats is not None:
+            raise FleetDegraded(
+                "fleet degraded (quarantined seats below min_replicas); "
+                "every survivor's admission control refused the request",
+                quarantined=self._seats.gated(now),
+                live=len(self._replicas),
+                queue_depth=total,
+                oldest_age=max(oldest) if oldest else None,
+                replicas=len(ranked),
+                class_depths=class_depths or None,
+                class_oldest=class_oldest or None)
         raise FleetSaturated(
             "every replica's admission control refused the request",
             queue_depth=total, oldest_age=max(oldest) if oldest else None,
@@ -735,6 +853,10 @@ class ReplicaFleet:
         the completions this round retired (failover casualties
         included)."""
         done: List[Completion] = []
+        # parked failover re-admissions (every survivor transiently
+        # full at failover time) retry BEFORE the dispatch turns, so a
+        # re-seated request joins this very tick's prefill action
+        self._pump_parked(done)
         # drive order: replicas with a runnable action (a dispatch to
         # enqueue, or an async dispatch to reconcile) go FIRST, idle
         # replicas after — strict list order used to park queued work
@@ -755,12 +877,16 @@ class ReplicaFleet:
         for rep in silent:
             if rep in self._replicas:
                 done.extend(self._fail_replica(rep, dead=False))
-        if len(self._replicas) < self._target_replicas:
+        if len(self._replicas) < self._target_replicas and (
+                self._seats is None
+                or self._seats.allow_build(self.now())):
             # catch-up restoration: a failover that found the standby
             # pool empty (raced refill — or no pool at all) must not
             # leave the fleet serving short forever. Warm-promote if a
             # standby landed, cold-build otherwise: the construction
             # cost lands on THIS tick, off the failover critical path.
+            # Quarantined seats gate this path: a crash-looping seat
+            # rebuilds on its backoff schedule, not every tick.
             rep, source = self._adopt_standby_or_build(cold_ok=True)
             self._rebuild_monitor()
             if self._tel is not None:
@@ -769,7 +895,23 @@ class ReplicaFleet:
                                 replicas_live=len(self._replicas))
         if self._cfg.autoscale:
             self._autoscale()
+        self._pump_probation(done)
         tel = self._tel
+        if self._seats is not None:
+            gated = self._seats.gated(self.now())
+            deg = (gated > 0
+                   and len(self._replicas) < self._cfg.min_replicas)
+            if deg != self._degraded:
+                self._degraded = deg
+                if tel is not None:
+                    tel.event(EVENT_DEGRADED if deg else EVENT_RESTORED,
+                              quarantined=gated,
+                              replicas_live=len(self._replicas))
+            if tel is not None:
+                tel.metrics.gauge(
+                    GAUGE_QUARANTINED,
+                    help="empty replica seats inside their quarantine "
+                         "backoff window").set(gated)
         if tel is not None:
             tel.metrics.gauge(
                 GAUGE_REPLICAS_LIVE,
@@ -852,6 +994,20 @@ class ReplicaFleet:
         engine = rep.client.engine
         entries = engine.snapshot_in_flight()
         queued = rep.client.scheduler.waiting
+        # every co-batched in-flight request is IMPLICATED by this
+        # death (queued requests never touched the engine and are not);
+        # the counter rides the request object through re-admission,
+        # like replay_tokens. Implication is not proof — probation
+        # sorts innocents from poison (docs/reliability.md).
+        for _req, _toks in entries:
+            _req.crash_implications += 1
+        if self._probation_rep == rep.id:
+            # the probation replica died — almost certainly the suspect
+            # crashed it. Release the reservation; the suspect rides
+            # the normal re-admission path below with its bumped count
+            # (back to probation, or out at the budget).
+            self._probation_rep = None
+            self._probation_obj = None
         if tel is not None:
             tel.event(EVENT_FAILOVER, replica=rep.id, dead=dead,
                       in_flight=len(entries), queued=len(queued),
@@ -865,6 +1021,11 @@ class ReplicaFleet:
         # remove BEFORE re-admission: the router must never route the
         # dead replica's own work back onto it
         self._remove_replica(rep)
+        if self._seats is not None:
+            next_build = self._seats.record_death(rep.id, self.now())
+            if next_build is not None and tel is not None:
+                tel.event(EVENT_QUARANTINE, replica=rep.id,
+                          next_build=round(next_build, 6))
         # sweep the dead client's completion ledger: a crashing tick
         # commits its already-collected expiry/cancel completions
         # client-side before unwinding (ServeClient._finalize) — they
@@ -900,47 +1061,213 @@ class ReplicaFleet:
         already-emitted tokens re-feed through a survivor's prefill, so
         its token stream continues at the same ``fold_in`` step —
         deadline, arrival time and any first-token stamp ride the
-        request object unchanged."""
-        from ray_lightning_tpu.reliability.supervisor import \
-            failed_completion
+        request object unchanged.
+
+        Containment armed (``max_request_failovers``), the request's
+        implication count gates the path: at the budget it retires
+        ``failed`` instead of consuming another replica; at
+        ``probation_after`` it queues for a solo probation run. A
+        *transient* refusal (every survivor QueueFull) parks the
+        request for retry on later ticks — only a permanent misfit
+        (outgrew the replay window, undeclared tenant/adapter) still
+        fails it here."""
         tel = self._tel
         if toks is not None:
             req.replay_tokens = list(toks)
             if tel is not None:
                 tel.event("recovery.replay", id=req.id,
                           replayed_tokens=len(toks))
+        budget = self._cfg.max_request_failovers
+        if budget is not None and req.crash_implications >= budget:
+            return self._retire_poison(req)
+        if (budget is not None
+                and req.crash_implications >= self._cfg.probation_after):
+            self._probation.append(req)
+            if tel is not None:
+                tel.event(EVENT_PROBATION, id=req.id, phase="queued",
+                          implications=req.crash_implications)
+            return []
         fed = req.prompt_len + len(req.replay_tokens or ())
         survivors = self._replicas
-        if survivors and fed <= survivors[0].client.engine.max_replay_len:
-            try:
-                self._admit(req)
-            except (QueueFull, ValueError) as exc:
-                log_suppressed("fleet.readmit", exc,
-                               f"request {req.id} unseatable after "
-                               "failover; retiring as failed")
-            else:
-                self.readmitted += 1
-                if tel is not None:
-                    tel.metrics.counter(
-                        COUNTER_READMITTED,
-                        help="requests re-admitted to surviving "
-                             "replicas after a failover").inc()
-                return []
-        # no survivor / outgrew the replay window / every survivor
-        # refused: the request fails with the tokens it already has —
-        # the fleet keeps serving everything else
+        if survivors:
+            if fed <= survivors[0].client.engine.max_replay_len:
+                try:
+                    self._admit(req)
+                except QueueFull as exc:
+                    # transiently full, not unseatable: park for
+                    # bounded re-admission (deadline still enforced,
+                    # _pump_parked) instead of instant failure
+                    log_suppressed("fleet.readmit", exc,
+                                   f"request {req.id} refused by every "
+                                   "survivor; parked for retry")
+                    self._park(req)
+                    return []
+                except ValueError as exc:
+                    log_suppressed("fleet.readmit", exc,
+                                   f"request {req.id} unseatable after "
+                                   "failover; retiring as failed")
+                else:
+                    self._count_readmitted()
+                    return []
+        elif self._seats is not None:
+            # degraded: no survivor YET, but quarantine backoff will
+            # rebuild one — park rather than insta-fail (the fit check
+            # happens against the rebuilt replica at pump time)
+            self._park(req)
+            return []
+        # outgrew the replay window / permanently unseatable / no
+        # survivor and no rebuild coming: the request fails with the
+        # tokens it already has — the fleet keeps serving everything
+        # else
+        return [self._fail_request(req)]
+
+    def _count_readmitted(self) -> None:
+        self.readmitted += 1
+        if self._tel is not None:
+            self._tel.metrics.counter(
+                COUNTER_READMITTED,
+                help="requests re-admitted to surviving "
+                     "replicas after a failover").inc()
+
+    def _fail_request(self, req: Request) -> Completion:
+        from ray_lightning_tpu.reliability.supervisor import \
+            failed_completion
         self.readmit_failed += 1
         comp = failed_completion(req, req.replay_tokens or ())
         comp.finish_time = self.now()
         self.completions[comp.request_id] = comp
-        return [comp]
+        return comp
 
-    def _adopt_standby_or_build(self, *, cold_ok: bool) \
+    def _retire_poison(self, req: Request) -> List[Completion]:
+        """The request spent its failover budget: retire it ``failed``
+        with its partial tokens instead of feeding it another replica."""
+        self.poison_failed += 1
+        tel = self._tel
+        if tel is not None:
+            tel.event(EVENT_POISON_FAILED, id=req.id,
+                      implications=req.crash_implications,
+                      tokens=len(req.replay_tokens or ()))
+            tel.metrics.counter(
+                COUNTER_POISON_FAILED,
+                help="requests retired failed at their failover "
+                     "budget (suspected poison)").inc()
+        return [self._fail_request(req)]
+
+    def _park(self, req: Request) -> None:
+        self._parked.append(req)
+        if self._tel is not None:
+            self._tel.event(EVENT_READMIT_PARKED, id=req.id,
+                            parked=len(self._parked))
+
+    def _pump_parked(self, done: List[Completion]) -> None:
+        """Retry every parked failover re-admission: deadline expiries
+        retire ``timeout`` with their partial tokens (the client-side
+        expiry contract), fits re-admit through the router, still-full
+        stays parked for the next tick."""
+        if not self._parked:
+            return
+        still: List[Request] = []
+        now = self.now()
+        for req in self._parked:
+            if req.deadline is not None and now >= req.deadline:
+                comp = Completion(
+                    request_id=req.id, prompt=list(req.prompt),
+                    tokens=list(req.replay_tokens or []),
+                    finish_reason=FINISH_TIMEOUT,
+                    arrival_time=req.arrival_time,
+                    first_token_time=req.first_token_time,
+                    finish_time=now,
+                    prefix_hit_tokens=req.prefix_hit_tokens,
+                    tenant=req.tenant, adapter=req.adapter)
+                self.completions[comp.request_id] = comp
+                done.append(comp)
+                continue
+            survivors = self._replicas
+            if not survivors:
+                still.append(req)
+                continue
+            fed = req.prompt_len + len(req.replay_tokens or ())
+            if fed > survivors[0].client.engine.max_replay_len:
+                done.append(self._fail_request(req))
+                continue
+            try:
+                self._admit(req)
+            except QueueFull:
+                still.append(req)
+            except ValueError as exc:
+                log_suppressed("fleet.readmit", exc,
+                               f"parked request {req.id} permanently "
+                               "unseatable; retiring as failed")
+                done.append(self._fail_request(req))
+            else:
+                self._count_readmitted()
+        self._parked = still
+
+    def _pump_probation(self, done: List[Completion]) -> None:
+        """Drive the probation lane: a retired suspect's clean run
+        resets its implication count and releases the reserved
+        replica; the next suspect seats solo once the reservation is
+        idle. Reserving waits for a second admitting replica (unless
+        the fleet's target IS one) so regular traffic keeps a lane."""
+        obj = self._probation_obj
+        if obj is not None:
+            comp = self.completions.get(obj.id)
+            if comp is None:
+                return  # suspect still running solo
+            # clean run: the "poison" evidence didn't reproduce —
+            # exonerate (the implication-vs-proof caveat in
+            # docs/reliability.md)
+            obj.crash_implications = 0
+            rep_id, self._probation_rep = self._probation_rep, None
+            self._probation_obj = None
+            if self._tel is not None:
+                self._tel.event(EVENT_PROBATION_CLEARED, id=obj.id,
+                                replica=rep_id,
+                                finish_reason=comp.finish_reason)
+        if not self._probation:
+            return
+        if self._probation_rep is None:
+            admitting = sorted(
+                (r for r in self._replicas if r.admitting),
+                key=lambda r: r.id)
+            if not admitting:
+                return
+            if len(admitting) < 2 and self._target_replicas > 1:
+                return  # a second replica is coming; keep traffic moving
+            self._probation_rep = admitting[0].id
+        rep = next((r for r in self._replicas
+                    if r.id == self._probation_rep), None)
+        if rep is None or not rep.admitting:
+            self._probation_rep = None
+            return
+        if rep.busy:
+            return  # let the reserved replica drain its regular work
+        req = self._probation[0]
+        fed = req.prompt_len + len(req.replay_tokens or ())
+        if fed > rep.client.engine.max_replay_len:
+            self._probation.pop(0)
+            done.append(self._fail_request(req))
+            return
+        try:
+            rep.client.submit_request(req)
+        except QueueFull:
+            return  # idle replica refused (quota edge); retry next tick
+        self._probation.pop(0)
+        self._probation_obj = req
+        if self._tel is not None:
+            self._tel.event(EVENT_PROBATION, id=req.id, phase="seated",
+                            replica=rep.id,
+                            implications=req.crash_implications)
+
+    def _adopt_standby_or_build(self, *, cold_ok: bool,
+                                grow: bool = False) \
             -> Tuple[Optional[_Replica], Optional[str]]:
         """The one add-a-replica sequence every growth path shares:
         take a warm standby (kicking the background refill behind it),
         else cold-build when ``cold_ok``. Returns ``(None, None)`` when
-        the pool is empty and a cold build is not warranted."""
+        the pool is empty and a cold build is not warranted. ``grow``
+        marks deliberate new capacity (scale-out): quarantine armed, it
+        seats a FRESH seat instead of filling a gated one."""
         client = self.standby.take() if self.standby is not None else None
         source = "standby" if client is not None else None
         if client is None:
@@ -963,6 +1290,8 @@ class ReplicaFleet:
             for name, tree in want.items():
                 client.load_adapter(name, tree)
         rep = self._adopt(client)
+        if self._seats is not None:
+            self._seats.occupy(rep.id, self.now(), grow=grow)
         if self.standby is not None:
             self.standby.refill_async(self._build_client)
         return rep, source
@@ -989,6 +1318,12 @@ class ReplicaFleet:
         ``_target_replicas`` on the next round — warm if a standby
         landed by then, cold otherwise — so a failover never leaves
         the fleet short forever."""
+        if (self._seats is not None
+                and not self._seats.allow_build(self.now())):
+            # every empty seat is quarantined: the rebuild waits for
+            # its backoff (tick-time catch-up performs it), even below
+            # min_replicas — that's what degraded mode is for
+            return
         rep, source = self._adopt_standby_or_build(
             cold_ok=len(self._replicas) < self._cfg.min_replicas)
         if rep is None:
@@ -1029,7 +1364,8 @@ class ReplicaFleet:
                 self._retire_replica(rep)
 
     def _scale_out(self) -> None:
-        rep, source = self._adopt_standby_or_build(cold_ok=True)
+        rep, source = self._adopt_standby_or_build(cold_ok=True,
+                                                   grow=True)
         self.scale_outs += 1
         self._target_replicas = len(self._replicas)
         self._rebuild_monitor()
@@ -1042,7 +1378,9 @@ class ReplicaFleet:
         """Scale-in is a drain, never a kill: the newest admitting
         replica stops taking requests; its in-flight work retires
         normally and only then is it shut down."""
-        rep = max(admitting, key=lambda r: r.id)
+        candidates = [r for r in admitting
+                      if r.id != self._probation_rep] or admitting
+        rep = max(candidates, key=lambda r: r.id)
         rep.draining = True
         if self._tel is not None:
             self._tel.event(EVENT_REPLICA_DRAINING, replica=rep.id,
@@ -1051,6 +1389,9 @@ class ReplicaFleet:
 
     def _retire_replica(self, rep: _Replica) -> None:
         self._remove_replica(rep)
+        if self._seats is not None:
+            # a deliberate drain is not a death: the seat retires clean
+            self._seats.vacate(rep.id)
         self.scale_ins += 1
         self._target_replicas = len(self._replicas)
         self._rebuild_monitor()
@@ -1060,7 +1401,9 @@ class ReplicaFleet:
 
     # ---------------------------------------------------------- driving
     def _busy(self) -> bool:
-        return any(rep.busy for rep in self._replicas)
+        return (any(rep.busy for rep in self._replicas)
+                or bool(self._parked) or bool(self._probation)
+                or self._probation_obj is not None)
 
     def run_until_idle(self, max_ticks: int = 100_000) \
             -> Dict[int, Completion]:

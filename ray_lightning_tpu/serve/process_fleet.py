@@ -61,20 +61,30 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ray_lightning_tpu.reliability import log_suppressed
+from ray_lightning_tpu.reliability import faults, log_suppressed
+from ray_lightning_tpu.serve.containment import SeatTable
 from ray_lightning_tpu.serve.fleet import (COUNTER_FAILOVERS,
+                                           COUNTER_POISON_FAILED,
                                            COUNTER_READMITTED, COUNTER_SHED,
-                                           EVENT_FAILOVER,
+                                           EVENT_DEGRADED, EVENT_FAILOVER,
+                                           EVENT_POISON_FAILED,
+                                           EVENT_PROBATION,
+                                           EVENT_PROBATION_CLEARED,
+                                           EVENT_QUARANTINE,
+                                           EVENT_READMIT_PARKED,
                                            EVENT_REPLICA_DRAINING,
                                            EVENT_REPLICA_PROMOTED,
+                                           EVENT_RESTORED,
                                            EVENT_SCALE_IN, EVENT_SCALE_OUT,
                                            EVENT_SHED, FleetConfig,
-                                           FleetSaturated,
+                                           FleetDegraded, FleetSaturated,
+                                           GAUGE_QUARANTINED,
                                            GAUGE_QUEUE_DEPTH,
                                            GAUGE_REPLICAS_LIVE, ReplicaFleet,
                                            Router, RouterConfig)
 from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
-                                             FINISH_REJECTED, Request)
+                                             FINISH_REJECTED, FINISH_TIMEOUT,
+                                             Request)
 from ray_lightning_tpu.serve.scheduler import QueueFull
 
 __all__ = ["ProcessReplicaFleet"]
@@ -171,7 +181,8 @@ class _ProcessReplica:
     ``.admitting``, ``.client.scheduler``, ``.client.engine``)."""
 
     __slots__ = ("id", "actor", "info", "client", "draining", "crashed",
-                 "crash_msg", "last_beat", "last_step", "beats")
+                 "crash_msg", "crash_implicated", "last_beat", "last_step",
+                 "beats")
 
     def __init__(self, replica_id: int, actor: Any, info: Dict[str, Any]):
         self.id = replica_id
@@ -181,6 +192,12 @@ class _ProcessReplica:
         self.draining = False
         self.crashed = False
         self.crash_msg: Optional[str] = None
+        #: request ids the dying worker reported as in its engine when
+        #: the dispatch loop crashed (MSG_CRASH 4th field) — None when
+        #: the crash predates the field or the process died messageless
+        #: (kill -9), in which case implication falls back to ALL
+        #: displaced (conservative; probation exonerates innocents)
+        self.crash_implicated: Optional[List[int]] = None
         self.last_beat: Optional[float] = None
         self.last_step = -1
         self.beats = 0
@@ -353,6 +370,26 @@ class ProcessReplicaFleet(ReplicaFleet):
         self.scale_ins = 0
         self.failover_s_total = 0.0
 
+        # failure containment (same inert-by-default contract as the
+        # in-process fleet: nothing here changes a decision until
+        # max_request_failovers / flap_window are set)
+        self.poison_failed = 0
+        self._parked: List[Request] = []
+        self._probation: List[Request] = []
+        self._probation_rep: Optional[int] = None
+        self._probation_obj: Optional[Request] = None
+        self._degraded = False
+        self._seats: Optional[SeatTable] = None
+        if self._cfg.flap_window is not None:
+            from ray_lightning_tpu.reliability.retry import RetryPolicy
+            policy = self._cfg.quarantine_backoff or RetryPolicy(
+                max_attempts=8, base_delay=1.0, max_delay=60.0,
+                multiplier=2.0, jitter=0.1)
+            self._seats = SeatTable(self._cfg.flap_window,
+                                    self._cfg.flap_threshold, policy)
+            for rep in self._replicas:
+                self._seats.occupy(rep.id, self.now(), grow=True)
+
     # ------------------------------------------------------------ clock
     @property
     def ops(self) -> int:
@@ -402,7 +439,11 @@ class ProcessReplicaFleet(ReplicaFleet):
             worker_env=env, construct_timeout=300.0).remote(
             self._model, self._params_host, self._engine_kwargs,
             self._out, self._hb, self._epoch,
-            heartbeat_interval=hb_interval)
+            heartbeat_interval=hb_interval,
+            # ship the driver's armed fault plan (if any) so worker-side
+            # engines fire the same sites — chaos drills (and the
+            # poison leg of the bench) hold identically on this backend
+            fault_plan=faults.get_armed())
 
     def _activate(self, handle: Any) -> _ProcessReplica:
         rid = self._next_replica_id
@@ -474,6 +515,10 @@ class ProcessReplicaFleet(ReplicaFleet):
             # existing stamp (the router-seat contract)
             req.arrival_time = self.now()
         ranked = self.router.order(self._replicas, req)
+        if self._probation_rep is not None:
+            # the probation replica is reserved for its solo suspect —
+            # regular traffic routes around it until the run clears
+            ranked = [r for r in ranked if r.id != self._probation_rep]
         affine_target = self.router.affine_target(req)
         for rep in ranked:
             if rep not in self._replicas:
@@ -515,6 +560,17 @@ class ProcessReplicaFleet(ReplicaFleet):
                 class_depths[name] = class_depths.get(name, 0) + depth
             for name, age in r.client.scheduler.class_oldest(now).items():
                 class_oldest[name] = max(class_oldest.get(name, age), age)
+        if self._degraded and self._seats is not None:
+            raise FleetDegraded(
+                "fleet degraded (quarantined seats below min_replicas); "
+                "every survivor's admission control refused the request",
+                quarantined=self._seats.gated(now),
+                live=len(self._replicas),
+                queue_depth=total,
+                oldest_age=max(oldest) if oldest else None,
+                replicas=len(ranked),
+                class_depths=class_depths or None,
+                class_oldest=class_oldest or None)
         raise FleetSaturated(
             "every replica's admission control refused the request",
             queue_depth=total, oldest_age=max(oldest) if oldest else None,
@@ -531,6 +587,7 @@ class ProcessReplicaFleet(ReplicaFleet):
         supervision forward. Returns completions recorded this round
         (failover casualties included)."""
         done: List[Completion] = []
+        self._pump_parked(done)
         self._drain_messages(done)
         self._drain_beats()
         for rep in list(self._replicas):
@@ -541,7 +598,11 @@ class ProcessReplicaFleet(ReplicaFleet):
             rep = idx_map.get(i)
             if rep is not None and rep in self._replicas:
                 done.extend(self._fail_replica(rep))
-        if len(self._replicas) < self._target_replicas:
+        if len(self._replicas) < self._target_replicas and (
+                self._seats is None
+                or self._seats.allow_build(self.now())):
+            # quarantined seats gate this catch-up: a crash-looping
+            # seat rebuilds on its backoff schedule, not every pump
             rep, source = self._adopt_standby_or_build(cold_ok=True)
             self._rebuild_monitor()
             if self._tel is not None and rep is not None:
@@ -550,8 +611,24 @@ class ProcessReplicaFleet(ReplicaFleet):
                                 replicas_live=len(self._replicas))
         if self._cfg.autoscale:
             self._autoscale()
+        self._pump_probation(done)
         self._ticks += 1
         tel = self._tel
+        if self._seats is not None:
+            gated = self._seats.gated(self.now())
+            deg = (gated > 0
+                   and len(self._replicas) < self._cfg.min_replicas)
+            if deg != self._degraded:
+                self._degraded = deg
+                if tel is not None:
+                    tel.event(EVENT_DEGRADED if deg else EVENT_RESTORED,
+                              quarantined=gated,
+                              replicas_live=len(self._replicas))
+            if tel is not None:
+                tel.metrics.gauge(
+                    GAUGE_QUARANTINED,
+                    help="empty replica seats inside their quarantine "
+                         "backoff window").set(gated)
         if tel is not None:
             tel.metrics.gauge(
                 GAUGE_REPLICAS_LIVE,
@@ -608,6 +685,8 @@ class ProcessReplicaFleet(ReplicaFleet):
                     if rep is not None:
                         rep.crashed = True
                         rep.crash_msg = msg[2]
+                        rep.crash_implicated = (
+                            list(msg[3]) if len(msg) > 3 else None)
 
     def _apply_metric(self, msg: Tuple) -> None:
         _mk, _rid, kind, name, help_, op, value = msg
@@ -672,6 +751,28 @@ class ProcessReplicaFleet(ReplicaFleet):
             key=lambda t: t.req.id)
         in_flight = sum(1 for t in displaced
                         if t.tokens or t.req.first_token_time is not None)
+        # implication across the process boundary: an "error" verdict
+        # ships the crashing engine's exact in-flight set (MSG_CRASH),
+        # so only those ids are implicated. A messageless death
+        # (kill -9 → "dead", wedge → "hung") names nobody — every
+        # displaced request is implicated conservatively; probation
+        # exonerates innocents (the implication-vs-proof caveat,
+        # docs/reliability.md#failure-containment).
+        if verdict == "error" and rep.crash_implicated is not None:
+            guilty = set(rep.crash_implicated)
+            for t in displaced:
+                if t.req.id in guilty:
+                    t.req.crash_implications += 1
+        else:
+            for t in displaced:
+                t.req.crash_implications += 1
+        if self._probation_rep == rep.id:
+            # the probation replica died — almost certainly the suspect
+            # crashed it. Release the reservation; the suspect rides
+            # the normal re-admission path below with its bumped count
+            # (back to probation, or out at the budget).
+            self._probation_rep = None
+            self._probation_obj = None
         if tel is not None:
             if verdict == "dead":
                 tel.event(EVENT_REPLICA_DEAD, replica=rep.id,
@@ -697,6 +798,11 @@ class ProcessReplicaFleet(ReplicaFleet):
                            f"replica {rep.id} kill failed")
         self._replicas.remove(rep)
         self.router.forget(rep.id)
+        if self._seats is not None:
+            next_build = self._seats.record_death(rep.id, self.now())
+            if next_build is not None and tel is not None:
+                tel.event(EVENT_QUARANTINE, replica=rep.id,
+                          next_build=round(next_build, 6))
         for t in displaced:
             self._inflight.pop(t.req.id, None)
         promoted_early = False
@@ -717,38 +823,172 @@ class ProcessReplicaFleet(ReplicaFleet):
         ledger's request object (original arrival/deadline/first-token
         stamps, tenant class) re-feeds with ``replay_tokens`` set to
         the last flushed stream — the survivor's prefill resumes the
-        sampling-key stream at the same ``fold_in`` step."""
-        from ray_lightning_tpu.reliability.supervisor import \
-            failed_completion
+        sampling-key stream at the same ``fold_in`` step.
+
+        Containment semantics match the in-process fleet exactly:
+        budget-spent requests retire ``failed``, twice-implicated ones
+        queue for solo probation, transiently-refused ones park for
+        bounded retry instead of insta-failing."""
         tel = self._tel
         if toks is not None:
             req.replay_tokens = list(toks)
             if tel is not None:
                 tel.event("recovery.replay", id=req.id,
                           replayed_tokens=len(toks))
+        budget = self._cfg.max_request_failovers
+        if budget is not None and req.crash_implications >= budget:
+            return self._retire_poison(req)
+        if (budget is not None
+                and req.crash_implications >= self._cfg.probation_after):
+            self._probation.append(req)
+            if tel is not None:
+                tel.event(EVENT_PROBATION, id=req.id, phase="queued",
+                          implications=req.crash_implications)
+            return []
         fed = req.prompt_len + len(req.replay_tokens or ())
         survivors = self._replicas
-        if survivors and fed <= survivors[0].info["max_replay_len"]:
+        if survivors:
+            if fed <= survivors[0].info["max_replay_len"]:
+                try:
+                    self._admit(req)
+                except QueueFull as exc:
+                    # FleetSaturated (the RPC admission path's refusal)
+                    # subclasses QueueFull — transiently full, not
+                    # unseatable: park for bounded re-admission
+                    log_suppressed("fleet.readmit", exc,
+                                   f"request {req.id} refused by every "
+                                   "survivor; parked for retry")
+                    self._park(req)
+                    return []
+                except ValueError as exc:
+                    log_suppressed("fleet.readmit", exc,
+                                   f"request {req.id} unseatable after "
+                                   "failover; retiring as failed")
+                else:
+                    self._count_readmitted()
+                    return []
+        elif self._seats is not None:
+            # degraded: no survivor YET, but quarantine backoff will
+            # rebuild one — park rather than insta-fail (the fit check
+            # happens against the rebuilt replica at pump time)
+            self._park(req)
+            return []
+        return [self._fail_request(req)]
+
+    def _pump_parked(self, done: List[Completion]) -> None:
+        """Process-backend parked-retry pump: same contract as the
+        in-process fleet (deadline expiries retire ``timeout``, fits
+        re-admit through the router, still-full stays parked) with the
+        fit check against the replica info dict and refusals arriving
+        as :class:`FleetSaturated` from the RPC admission path."""
+        if not self._parked:
+            return
+        still: List[Request] = []
+        now = self.now()
+        for req in self._parked:
+            if req.deadline is not None and now >= req.deadline:
+                comp = Completion(
+                    request_id=req.id, prompt=list(req.prompt),
+                    tokens=list(req.replay_tokens or []),
+                    finish_reason=FINISH_TIMEOUT,
+                    arrival_time=req.arrival_time,
+                    first_token_time=req.first_token_time,
+                    finish_time=now,
+                    prefix_hit_tokens=req.prefix_hit_tokens,
+                    tenant=req.tenant, adapter=req.adapter)
+                self.completions[comp.request_id] = comp
+                done.append(comp)
+                continue
+            survivors = self._replicas
+            if not survivors:
+                still.append(req)
+                continue
+            fed = req.prompt_len + len(req.replay_tokens or ())
+            if fed > survivors[0].info["max_replay_len"]:
+                done.append(self._fail_request(req))
+                continue
             try:
                 self._admit(req)
-            except (QueueFull, ValueError) as exc:
+            except QueueFull:
+                still.append(req)
+            except ValueError as exc:
                 log_suppressed("fleet.readmit", exc,
-                               f"request {req.id} unseatable after "
-                               "failover; retiring as failed")
+                               f"parked request {req.id} permanently "
+                               "unseatable; retiring as failed")
+                done.append(self._fail_request(req))
             else:
-                self.readmitted += 1
-                if tel is not None:
-                    tel.metrics.counter(
-                        COUNTER_READMITTED,
-                        help="requests re-admitted to surviving "
-                             "replicas after a failover").inc()
-                return []
-        self.readmit_failed += 1
-        comp = failed_completion(req, req.replay_tokens or ())
-        comp.finish_time = self.now()
-        return [comp]
+                self._count_readmitted()
+        self._parked = still
 
-    def _adopt_standby_or_build(self, *, cold_ok: bool) \
+    def _pump_probation(self, done: List[Completion]) -> None:
+        """Process-backend probation lane: identical policy to the
+        in-process fleet; the solo seat rides a submit RPC plus a
+        ledger entry (the suspect must stay failover-tracked — its
+        probation replica dying IS the strongest poison signal), and
+        the reserved replica's idleness reads the mirror stats plus
+        the driver ledger."""
+        obj = self._probation_obj
+        if obj is not None:
+            comp = self.completions.get(obj.id)
+            if comp is None:
+                return  # suspect still running solo
+            obj.crash_implications = 0
+            rep_id, self._probation_rep = self._probation_rep, None
+            self._probation_obj = None
+            if self._tel is not None:
+                self._tel.event(EVENT_PROBATION_CLEARED, id=obj.id,
+                                replica=rep_id,
+                                finish_reason=comp.finish_reason)
+        if not self._probation:
+            return
+        if self._probation_rep is None:
+            admitting = sorted(
+                (r for r in self._replicas if r.admitting),
+                key=lambda r: r.id)
+            if not admitting:
+                return
+            if len(admitting) < 2 and self._target_replicas > 1:
+                return  # a second replica is coming; keep traffic moving
+            self._probation_rep = admitting[0].id
+        rep = next((r for r in self._replicas
+                    if r.id == self._probation_rep), None)
+        if rep is None or not rep.admitting:
+            self._probation_rep = None
+            return
+        if rep.busy or any(t.replica == rep.id
+                           for t in self._inflight.values()):
+            return  # let the reserved replica drain its regular work
+        req = self._probation[0]
+        fed = req.prompt_len + len(req.replay_tokens or ())
+        if fed > rep.info["max_replay_len"]:
+            self._probation.pop(0)
+            done.append(self._fail_request(req))
+            return
+        try:
+            verdict = self._ray.get(rep.actor.submit.remote(req),
+                                    timeout=self._submit_timeout)
+        except ValueError:
+            self._probation.pop(0)
+            done.append(self._fail_request(req))
+            return
+        except Exception as exc:  # noqa: BLE001 — a dying probation seat fails over on the next pump
+            log_suppressed("fleet.probation", exc,
+                           f"probation replica {rep.id} unreachable; "
+                           "retrying the suspect next pump")
+            return
+        if not verdict["ok"]:
+            return  # idle replica refused (quota edge); retry next pump
+        rep.apply_stats(verdict["stats"])
+        self._probation.pop(0)
+        self._inflight[req.id] = _Tracked(req, rep.id)
+        self._probation_obj = req
+        if self._tel is not None:
+            self._tel.event(EVENT_PROBATION, id=req.id, phase="seated",
+                            replica=rep.id,
+                            implications=req.crash_implications)
+
+    def _adopt_standby_or_build(self, *, cold_ok: bool,
+                                grow: bool = False) \
             -> Tuple[Optional[_ProcessReplica], Optional[str]]:
         handle = self.standby.take() if self.standby is not None else None
         source = "standby" if handle is not None else None
@@ -769,11 +1009,20 @@ class ProcessReplicaFleet(ReplicaFleet):
                                "could not kill failed standby")
             rep = self._activate(self._spawn_actor())
             source = "cold"
+        if self._seats is not None:
+            self._seats.occupy(rep.id, self.now(), grow=grow)
         if self.standby is not None:
             self.standby.refill_async(self._spawn_actor)
         return rep, source
 
     def _promote(self) -> None:
+        if self._seats is not None and not self._seats.allow_build(
+                self.now()):
+            # every empty seat is quarantined: the failover path must
+            # not hot-rebuild into a crash-looping seat — degraded
+            # mode (shed + survivors) covers the gap until the
+            # backoff elapses and the catch-up path rebuilds
+            return
         rep, source = self._adopt_standby_or_build(
             cold_ok=len(self._replicas) < self._cfg.min_replicas)
         if rep is None:
@@ -827,7 +1076,8 @@ class ProcessReplicaFleet(ReplicaFleet):
                 self._retire_replica(rep)
 
     def _scale_out(self) -> None:
-        rep, source = self._adopt_standby_or_build(cold_ok=True)
+        rep, source = self._adopt_standby_or_build(cold_ok=True,
+                                                   grow=True)
         self.scale_outs += 1
         self._target_replicas = len(self._replicas)
         self._rebuild_monitor()
@@ -837,7 +1087,9 @@ class ProcessReplicaFleet(ReplicaFleet):
                             replicas_live=len(self._replicas))
 
     def _drain_one(self, admitting: List[_ProcessReplica]) -> None:
-        rep = max(admitting, key=lambda r: r.id)
+        candidates = [r for r in admitting
+                      if r.id != self._probation_rep] or admitting
+        rep = max(candidates, key=lambda r: r.id)
         rep.draining = True
         if self._tel is not None:
             self._tel.event(EVENT_REPLICA_DRAINING, replica=rep.id,
@@ -859,6 +1111,8 @@ class ProcessReplicaFleet(ReplicaFleet):
                            f"replica {rep.id} kill failed")
         self._replicas.remove(rep)
         self.router.forget(rep.id)
+        if self._seats is not None:
+            self._seats.vacate(rep.id)  # deliberate drain, not a death
         self.scale_ins += 1
         self._target_replicas = len(self._replicas)
         self._rebuild_monitor()
@@ -868,7 +1122,9 @@ class ProcessReplicaFleet(ReplicaFleet):
 
     # ---------------------------------------------------------- driving
     def _busy(self) -> bool:
-        return bool(self._inflight)
+        return (bool(self._inflight) or bool(self._parked)
+                or bool(self._probation)
+                or self._probation_obj is not None)
 
     def run_until_idle(self, max_ticks: int = 100_000) \
             -> Dict[int, Completion]:

@@ -90,6 +90,18 @@ class Request:
     # KV was adopted from the shared-prefix cache instead of computed
     # (0 = no hit / dense engine); surfaced on the Completion
     prefix_hit_tokens: int = 0
+    # failure-containment ledger (serve/containment.py): how many
+    # replica deaths this request has been co-batched with. Incremented
+    # by the fleet on every failover that displaces the request (and by
+    # ServeSupervisor on engine-level recoveries) and, like
+    # ``replay_tokens``, rides the request object through snapshot and
+    # re-admission. At ``FleetConfig.max_request_failovers`` the request
+    # retires ``failed`` with its partial tokens instead of consuming
+    # another replica; a clean probation run resets it to 0. This is an
+    # IMPLICATION count, not proof of guilt — innocents co-batched with
+    # a poison request are implicated too, which is exactly what the
+    # probation path exists to sort out (docs/reliability.md).
+    crash_implications: int = 0
     # LoRA adapter name (multi-adapter serving, serve/adapters.py):
     # which resident adapter's (A, B) pair this request's batch rows
     # gather inside the shared programs. None = the base model

@@ -1,0 +1,346 @@
+"""Poison-aware failure containment (fleet side).
+
+The load-bearing scenario: a poison request — deterministically
+crashing every dispatch it joins (the id-triggered ``serve.poison``
+fault site) — must retire ``finish_reason="failed"`` after at most
+``max_request_failovers`` replica deaths, while every innocent request
+(including co-batched ones the deaths *implicated*) finishes with
+tokens identical to an uninterrupted run. Around it: the probation
+lane that exonerates innocents, the seat-table crash-loop quarantine
+with its EXACT deterministic backoff schedule, degraded-mode shedding,
+and the inert-by-default contract (a default config never engages any
+of it).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.obs import Telemetry
+from ray_lightning_tpu.reliability import FaultPlan, RetryPolicy
+from ray_lightning_tpu.serve import (FINISH_FAILED, FleetConfig,
+                                     ReplicaFleet, ServeClient)
+from ray_lightning_tpu.serve.containment import SeatTable
+from ray_lightning_tpu.serve.fleet import FleetDegraded
+
+pytestmark = [pytest.mark.serve, pytest.mark.fleet]
+
+
+@pytest.fixture(scope="module")
+def nano():
+    mk = dict(vocab_size=128, max_seq_len=64, dtype=jnp.float32,
+              scan_layers=False)
+    dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+    params = TransformerLM(gpt2_config("nano", **mk)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+    return dec, params
+
+
+TRACE = [
+    (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+    (0, dict(prompt=[9, 2, 44], max_new_tokens=6)),
+    (1, dict(prompt=[42, 7], max_new_tokens=5)),
+    (2, dict(prompt=[1, 33], max_new_tokens=6)),
+]
+
+ENGINE = dict(num_slots=2, prefill_len=16)
+
+
+def _ref(dec, params, trace, **kw):
+    kw.setdefault("num_slots", 8)
+    kw.setdefault("prefill_len", 32)
+    client = ServeClient(dec, params, **kw)
+    out = client.serve_trace(trace)
+    client.shutdown()
+    return out
+
+
+# --------------------------------------------------------------------- #
+# seat table (unit)
+# --------------------------------------------------------------------- #
+def test_seat_table_exact_backoff_schedule():
+    """The quarantine gate IS the RetryPolicy schedule: every
+    quarantined rebuild time equals ``death_time + policy.delay(
+    attempt, salt=seat_id)`` exactly, and seats sharing one policy
+    de-correlate via the seat-id salt."""
+    policy = RetryPolicy(max_attempts=8, base_delay=4.0, max_delay=64.0,
+                         multiplier=2.0, jitter=0.25, seed=7)
+    table = SeatTable(flap_window=100.0, flap_threshold=2, policy=policy)
+    s0 = table.occupy(10, now=0.0, grow=True)
+    s1 = table.occupy(11, now=0.0, grow=True)
+    assert (s0, s1) == (0, 1)
+    # first death inside the window: healthy fast-rebuild
+    assert table.record_death(10, now=5.0) is None
+    assert table.allow_build(5.0)
+    assert table.occupy(12, now=5.0) == 0  # refills the SAME seat
+    # second death within the window trips quarantine, attempt 1
+    nb = table.record_death(12, now=9.0)
+    assert nb == 9.0 + policy.delay(1, salt=0)
+    assert not table.allow_build(nb - 1e-9)
+    assert table.gated(nb - 1e-9) == 1
+    assert table.allow_build(nb)
+    # seat 1 trips independently with its OWN salted schedule
+    table.record_death(11, now=9.0)
+    table.occupy(13, now=9.0)
+    nb1 = table.record_death(13, now=9.5)
+    assert nb1 == 9.5 + policy.delay(1, salt=1)
+    assert policy.delay(1, salt=0) != policy.delay(1, salt=1)
+    # rebuilding into seat 0 after its backoff, dying again inside the
+    # window: attempt advances, delay doubles (policy schedule, salted)
+    table.occupy(14, now=nb)
+    nb2 = table.record_death(14, now=nb + 1.0)
+    assert nb2 == nb + 1.0 + policy.delay(2, salt=0)
+
+
+def test_seat_table_window_aging_and_vacate():
+    policy = RetryPolicy(max_attempts=4, base_delay=2.0, jitter=0.0)
+    table = SeatTable(flap_window=10.0, flap_threshold=2, policy=policy)
+    table.occupy(0, now=0.0, grow=True)
+    assert table.record_death(0, now=1.0) is None
+    table.occupy(1, now=1.0)
+    # the survivor outlived the window: its seat re-enters at attempt 0
+    assert table.record_death(1, now=50.0) is None
+    assert table.allow_build(50.0)
+    # deliberate scale-in retires the seat entirely — not a death
+    table.occupy(2, now=50.0)
+    table.vacate(2)
+    assert table.gated(50.0) == 0
+    # growth never waits behind a quarantined seat
+    table.occupy(3, now=60.0, grow=True)
+    table.record_death(3, now=61.0)
+    table.occupy(4, now=61.0)
+    table.record_death(4, now=62.0)          # quarantined now
+    assert not table.allow_build(62.0)
+    sid = table.occupy(5, now=62.0, grow=True)
+    assert sid == 2               # a FRESH seat, not the gated one
+    assert table.gated(62.0) == 1  # the flapping seat stays gated
+
+
+# --------------------------------------------------------------------- #
+# poison containment (the tentpole scenario, in-process backend)
+# --------------------------------------------------------------------- #
+def test_poison_request_contained_within_budget(nano):
+    """PINNED (the acceptance scenario): one poison request on a
+    3-replica fleet crashes every dispatch it joins. With
+    ``max_request_failovers=3`` it retires ``failed`` after exactly 3
+    replica deaths (normal → normal → solo probation), every innocent
+    finishes with reference-identical tokens, and the probation lane's
+    queued→seated event order is pinned."""
+    dec, params = nano
+    poison_id = 1  # second arrival in TRACE
+    ref = _ref(dec, params,
+               [(t, kw) for i, (t, kw) in enumerate(TRACE)
+                if i != poison_id])
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=3, num_standby=2, telemetry=tel,
+        fleet_config=FleetConfig(max_request_failovers=3),
+        **ENGINE)
+    plan = FaultPlan(poison=(poison_id,))
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    # the poison retired failed, with exactly budget implications
+    assert out[poison_id].finish_reason == FINISH_FAILED
+    assert fleet.poison_failed == 1
+    assert fleet.failovers <= 3  # replicas lost <= max_request_failovers
+    # every innocent — co-batched implications and all — is token-exact
+    # (the reference run renumbers from 0; map back to fleet ids)
+    innocents = [i for i in range(len(TRACE)) if i != poison_id]
+    for ref_rid, fleet_rid in enumerate(innocents):
+        assert out[fleet_rid].tokens == ref[ref_rid].tokens, fleet_rid
+        assert out[fleet_rid].finish_reason != FINISH_FAILED, fleet_rid
+    # the suspect escalated through probation before retiring
+    phases = [e.payload["phase"] for e in tel.events("fleet.probation")
+              if e.payload["id"] == poison_id]
+    assert phases[:2] == ["queued", "seated"]
+    failed = [e.payload for e in tel.events("fleet.poison_failed")]
+    assert failed and failed[0]["id"] == poison_id
+    assert failed[0]["implications"] >= 3
+    snap = tel.metrics.snapshot()
+    assert snap["serve_fleet_poison_failed_total"] == 1
+    fleet.shutdown()
+
+
+def test_probation_exonerates_implicated_innocent(nano):
+    """Implication is not proof: on a sole-replica fleet EVERY request
+    is co-batched with the poison's crashes, so innocents rack up
+    implications too — the probation lane runs them solo, they finish
+    clean, and ``fleet.probation_cleared`` resets their count instead
+    of burning their budget."""
+    dec, params = nano
+    trace = [
+        (0, dict(prompt=[5, 17, 3, 9], max_new_tokens=6)),
+        (0, dict(prompt=[9, 2, 44], max_new_tokens=6)),
+    ]
+    poison_id = 0
+    ref = _ref(dec, params, [trace[1]])
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=1, num_standby=1, telemetry=tel,
+        fleet_config=FleetConfig(max_request_failovers=4),
+        **ENGINE)
+    plan = FaultPlan(poison=(poison_id,))
+    with plan.armed():
+        out = fleet.serve_trace(trace)
+    assert out[poison_id].finish_reason == FINISH_FAILED
+    # ref holds exactly one completion (the innocent, re-keyed id 0)
+    (ref_comp,) = ref.values()
+    assert out[1].tokens == ref_comp.tokens
+    assert out[1].finish_reason != FINISH_FAILED
+    cleared = [e.payload for e in tel.events("fleet.probation_cleared")]
+    assert any(p["id"] == 1 for p in cleared)
+    fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# crash-loop quarantine + degraded mode (fleet integration)
+# --------------------------------------------------------------------- #
+def test_quarantine_schedule_and_degraded_mode(nano):
+    """A flapping seat's rebuilds follow the exact RetryPolicy
+    schedule on the fleet tick clock; while the quarantine holds the
+    fleet below ``min_replicas``, sheds raise :class:`FleetDegraded`
+    and ``fleet.degraded``/``fleet.restored`` bracket the episode."""
+    dec, params = nano
+    policy = RetryPolicy(max_attempts=8, base_delay=4.0, max_delay=64.0,
+                         multiplier=2.0, jitter=0.25, seed=3)
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=1, num_standby=0, telemetry=tel,
+        fleet_config=FleetConfig(flap_window=200.0, flap_threshold=2,
+                                 quarantine_backoff=policy),
+        **ENGINE)
+    fleet.tick()
+    # death 1 inside the window: healthy — promotion rebuilds at once
+    t1 = fleet.now()
+    fleet._fail_replica(fleet._replicas[0], dead=True)
+    assert fleet.replicas_live == 1
+    assert not tel.events("fleet.quarantine")
+    fleet.tick()
+    # death 2 trips quarantine: rebuild gated to the exact schedule
+    t2 = fleet.now()
+    fleet._fail_replica(fleet._replicas[0], dead=True)
+    assert fleet.replicas_live == 0
+    quarantine = [e.payload for e in tel.events("fleet.quarantine")]
+    assert len(quarantine) == 1
+    expected = t2 + policy.delay(1, salt=0)
+    assert quarantine[0]["next_build"] == round(expected, 6)
+    # degraded: below min_replicas while the seat is gated — survivors
+    # (none here) keep serving, sheds carry the quarantine context
+    fleet.tick()
+    assert tel.events("fleet.degraded")
+    with pytest.raises(FleetDegraded) as err:
+        fleet.submit([5, 3], max_new_tokens=4)
+    assert err.value.quarantined == 1 and err.value.live == 0
+    assert tel.metrics.snapshot()["serve_fleet_quarantined"] == 1
+    # the catch-up path rebuilds at the FIRST tick past next_build —
+    # not one tick sooner, not one later
+    while fleet.replicas_live == 0:
+        fleet.tick()
+        assert fleet.now() <= math.ceil(expected)
+    assert fleet.now() == math.ceil(expected)
+    assert tel.events("fleet.restored")
+    assert tel.metrics.snapshot()["serve_fleet_quarantined"] == 0
+    # the rebuilt replica serves normally
+    fleet.submit([5, 3], max_new_tokens=4)
+    out = fleet.run_until_idle()
+    assert all(c.finish_reason != FINISH_FAILED for c in out.values())
+    fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# satellite: QueueFull at re-admission parks instead of failing
+# --------------------------------------------------------------------- #
+def test_readmit_queuefull_parks_then_readmits(nano):
+    """A failover displacing more work than the survivor can admit
+    used to insta-fail the overflow; it now parks for bounded
+    re-admission and every request retires with its tokens."""
+    from ray_lightning_tpu.serve import SchedulerConfig
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(
+        dec, params, num_replicas=2, num_standby=1, telemetry=tel,
+        num_slots=1, prefill_len=16,
+        scheduler_config=SchedulerConfig(max_queue_depth=1))
+    fleet.submit([3, 1], max_new_tokens=6)
+    fleet.submit([3, 2], max_new_tokens=6)
+    fleet.tick()  # both prefill into their slots, queues free again
+    fleet.submit([3, 3], max_new_tokens=6)
+    fleet.submit([3, 4], max_new_tokens=6)
+    # both replicas loaded (1 slot + 1 queued each); kill replica 1 —
+    # the survivor can admit at most one displaced request right now
+    fleet._fail_replica(fleet._replicas[1], dead=True)
+    assert tel.events("fleet.readmit_parked")
+    out = fleet.run_until_idle()
+    assert len(out) == 4
+    assert all(c.finish_reason != FINISH_FAILED for c in out.values()), \
+        {rid: c.finish_reason for rid, c in out.items()}
+    assert fleet.readmit_failed == 0
+    fleet.shutdown()
+
+
+def test_parked_request_deadline_enforced(nano):
+    """Parking does not suspend the deadline contract: a parked
+    request whose deadline lapses retires ``timeout`` with its partial
+    tokens on the next pump."""
+    from ray_lightning_tpu.serve import Request
+    dec, params = nano
+    fleet = ReplicaFleet(dec, params, num_replicas=1, num_standby=0,
+                         **ENGINE)
+    req = Request(id=777, prompt=[5, 3], max_new_tokens=8, deadline=2.0)
+    req.arrival_time = 0.0
+    req.replay_tokens = [9, 11]
+    for _ in range(3):
+        fleet.tick()  # advance the tick clock past the deadline
+    fleet._park(req)
+    done = fleet.tick()
+    assert [c.request_id for c in done] == [777]
+    assert done[0].finish_reason == "timeout"
+    assert done[0].tokens == [9, 11]
+    fleet.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# inert-by-default contract
+# --------------------------------------------------------------------- #
+def test_default_config_containment_is_inert(nano):
+    """A default-config fleet never engages containment: no seat
+    table, no probation/quarantine/degraded/poison events, and chaos
+    failovers behave exactly as before (every request finishes,
+    token-identical)."""
+    dec, params = nano
+    ref = _ref(dec, params, TRACE)
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, num_replicas=2, num_standby=1,
+                         telemetry=tel, **ENGINE)
+    assert fleet._seats is None
+    plan = FaultPlan.at("serve.replica", [3])
+    with plan.armed():
+        out = fleet.serve_trace(TRACE)
+    assert plan.fired == 1 and fleet.failovers == 1
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+    for site in ("fleet.quarantine", "fleet.probation",
+                 "fleet.probation_cleared", "fleet.degraded",
+                 "fleet.restored", "fleet.poison_failed"):
+        assert not tel.events(site), site
+    assert fleet.poison_failed == 0
+    snap = tel.metrics.snapshot()
+    assert "serve_fleet_poison_failed_total" not in snap
+    assert "serve_fleet_quarantined" not in snap
+    fleet.shutdown()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        FleetConfig(max_request_failovers=0)
+    with pytest.raises(ValueError):
+        FleetConfig(probation_after=0)
+    with pytest.raises(ValueError):
+        FleetConfig(flap_window=0.0)
+    with pytest.raises(ValueError):
+        FleetConfig(flap_threshold=0)
+    with pytest.raises(ValueError):
+        FleetConfig(quarantine_backoff=RetryPolicy())  # no flap_window

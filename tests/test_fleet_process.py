@@ -347,3 +347,149 @@ def test_process_fleet_failover_preserves_tenant_class(nano):
     admitted = tel.events("engine.tenant_admitted")
     assert {e.payload["tenant"] for e in admitted} >= {"fast", "bulk"}
     assert tel.events("replica.dead")
+
+
+# --------------------------------------------------------------------- #
+# PR 18: poison containment + autoscale churn across the process boundary
+# --------------------------------------------------------------------- #
+POISON_REQS = [
+    dict(prompt=[5, 17, 3, 9], max_new_tokens=18),
+    dict(prompt=[9, 2, 44], max_new_tokens=12),    # the poison pill
+    dict(prompt=[42, 7, 1], max_new_tokens=18),
+]
+
+
+@pytest.mark.multiproc
+def test_process_poison_contained_exact_implication(nano):
+    """A deterministically poisoned request (raises inside the worker's
+    prefill, every time, on every replica) burns through its failover
+    budget and retires ``failed``; co-batched innocents are implicated
+    but exonerated, finishing token-identical to an uninterrupted run.
+
+    ``MODE_RAISE`` keeps the worker alive long enough to ship the
+    4-tuple ``MSG_CRASH`` — the driver sees an ``error`` verdict with
+    an exact implicated-id list, so containment uses proof, not the
+    conservative all-displaced fallback."""
+    from ray_lightning_tpu.reliability import FaultPlan
+    from ray_lightning_tpu.serve import FINISH_FAILED, FleetConfig
+    dec, params = nano
+    tel = Telemetry()
+    poison_id = 1
+    plan = FaultPlan(poison=(poison_id,))
+    with plan.armed():
+        fleet = ReplicaFleet(
+            dec, params, backend="process", num_replicas=2,
+            num_standby=1, telemetry=tel,
+            fleet_config=FleetConfig(max_request_failovers=3,
+                                     probation_after=2),
+            **ENGINE)
+        try:
+            for kw in POISON_REQS:
+                fleet.submit(**kw)
+            out = fleet.run_until_idle()
+        finally:
+            backend = fleet.process_backend
+            fleet.shutdown()
+    assert out[poison_id].finish_reason == FINISH_FAILED
+    assert fleet.poison_failed == 1
+    assert fleet.failovers <= 3  # bounded by the request's budget
+    # the worker survived to ship MSG_CRASH: error verdict, never dead
+    assert tel.events("replica.error")
+    assert tel.events("fleet.poison_failed")
+    innocents = [i for i in range(len(POISON_REQS)) if i != poison_id]
+    ref = _ref(dec, params, [POISON_REQS[i] for i in innocents],
+               **{**ENGINE, "num_slots": 8})
+    for ref_rid, fleet_rid in enumerate(innocents):
+        assert out[fleet_rid].finish_reason != "failed", fleet_rid
+        assert out[fleet_rid].tokens == ref[ref_rid].tokens, fleet_rid
+    assert backend.live_actor_count() == 0
+
+
+@pytest.mark.multiproc
+@pytest.mark.slow
+def test_process_poison_kill9_conservative_implication(nano):
+    """``MODE_EXIT`` poison: the worker ``os._exit(17)``s before it can
+    ship MSG_CRASH, so every death classifies ``replica.dead`` and the
+    driver falls back to conservative implication (all displaced).
+    Innocents swept up by the fallback escape through probation; the
+    poison exhausts its budget there and retires ``failed``."""
+    from ray_lightning_tpu.reliability import MODE_EXIT, FaultPlan
+    from ray_lightning_tpu.serve import FINISH_FAILED, FleetConfig
+    dec, params = nano
+    tel = Telemetry()
+    poison_id = 1
+    plan = FaultPlan(poison=(poison_id,), poison_mode=MODE_EXIT)
+    with plan.armed():
+        fleet = ReplicaFleet(
+            dec, params, backend="process", num_replicas=2,
+            num_standby=1, telemetry=tel,
+            fleet_config=FleetConfig(max_request_failovers=3,
+                                     probation_after=2),
+            **ENGINE)
+        try:
+            for kw in POISON_REQS:
+                fleet.submit(**kw)
+            out = fleet.run_until_idle()
+        finally:
+            backend = fleet.process_backend
+            fleet.shutdown()
+    assert out[poison_id].finish_reason == FINISH_FAILED
+    assert fleet.poison_failed == 1
+    assert fleet.failovers <= 3
+    # hard exits: latch-first classification, no MSG_CRASH ever arrives
+    assert tel.events("replica.dead")
+    assert not tel.events("replica.error")
+    innocents = [i for i in range(len(POISON_REQS)) if i != poison_id]
+    ref = _ref(dec, params, [POISON_REQS[i] for i in innocents],
+               **{**ENGINE, "num_slots": 8})
+    for ref_rid, fleet_rid in enumerate(innocents):
+        assert out[fleet_rid].finish_reason != "failed", fleet_rid
+        assert out[fleet_rid].tokens == ref[ref_rid].tokens, fleet_rid
+    assert backend.live_actor_count() == 0
+
+
+@pytest.mark.multiproc
+@pytest.mark.slow
+def test_process_fleet_sustained_autoscale_churn(nano):
+    """Sustained churn: a queue burst scales the fleet out (warm standby
+    first), the trailing lull scales it back in — across real worker
+    processes, with zero stranded completions and single-engine token
+    identity throughout."""
+    from ray_lightning_tpu.serve import FleetConfig
+    dec, params = nano
+    tel = Telemetry()
+    burst = [(0.0 + 0.02 * i,
+              dict(prompt=[i + 1, 7], max_new_tokens=6 + (i % 3)))
+             for i in range(8)]
+    tail = [(0.8, dict(prompt=[3, 9, 27], max_new_tokens=32)),
+            (1.0, dict(prompt=[11, 4], max_new_tokens=32))]
+    trace = burst + tail
+    fleet = ReplicaFleet(
+        dec, params, backend="process", num_replicas=1, num_standby=1,
+        telemetry=tel, scale_eval_interval=0.05,
+        fleet_config=FleetConfig(autoscale=True, min_replicas=1,
+                                 max_replicas=3,
+                                 scale_out_queue_depth=1.0,
+                                 hysteresis=2),
+        num_slots=1, prefill_len=16)
+    try:
+        out = fleet.serve_trace(trace)
+        # the post-trace lull drains the fleet back toward min_replicas
+        _pump_until(fleet, lambda: fleet.scale_ins >= 1,
+                    msg="fleet never scaled back in after the burst")
+    finally:
+        backend = fleet.process_backend
+        fleet.shutdown()
+    assert fleet.scale_outs >= 1
+    assert fleet.scale_ins >= 1
+    # warm standby is preferred over a cold build for the first scale-out
+    so = tel.events("fleet.scale_out")
+    assert so and so[0].payload["source"] == "standby"
+    # no stranded completions: every submission retired, none failed/shed
+    ref = _ref(dec, params, [kw for _, kw in trace], num_slots=8,
+               prefill_len=16)
+    assert sorted(out) == sorted(ref)
+    for rid in ref:
+        assert out[rid].finish_reason == ref[rid].finish_reason, rid
+        assert out[rid].tokens == ref[rid].tokens, rid
+    assert backend.live_actor_count() == 0

@@ -1,0 +1,48 @@
+"""Lint: every fault-injection site is documented AND exercised.
+
+Sibling of ``test_lint_obs_docs.py``. The ``reliability.faults.SITES``
+registry is the chaos surface of the repo — each site name is a place
+a ``FaultSpec`` (or the poison hook) can detonate. Two drift modes
+used to be possible:
+
+- a site ships with no mention in ``docs/reliability.md``, so an
+  operator writing a chaos plan can't discover it exists; or
+- a site ships with no test referencing it, so the detonation path
+  itself is dead code that silently rots.
+
+This lint closes both: every key of ``SITES`` must appear verbatim in
+``docs/reliability.md`` and be referenced by at least one file under
+``tests/`` (other than this lint). A new site lands with a doc row and
+a test, or this file goes red.
+"""
+import pathlib
+
+import pytest
+
+from ray_lightning_tpu.reliability.faults import SITES
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DOC = ROOT / "docs" / "reliability.md"
+TESTS = ROOT / "tests"
+
+
+def _test_files():
+    me = pathlib.Path(__file__).resolve()
+    return [p for p in sorted(TESTS.glob("test_*.py"))
+            if p.resolve() != me]
+
+
+@pytest.mark.parametrize("site", sorted(SITES))
+def test_fault_site_documented(site):
+    assert DOC.exists(), "docs/reliability.md missing"
+    assert site in DOC.read_text(), (
+        f"fault site {site!r} is not documented in docs/reliability.md "
+        f"— add it to the injection-site table")
+
+
+@pytest.mark.parametrize("site", sorted(SITES))
+def test_fault_site_exercised(site):
+    hits = [p.name for p in _test_files() if site in p.read_text()]
+    assert hits, (
+        f"fault site {site!r} is referenced by no test file — wire it "
+        f"into a chaos test so the detonation path stays live")
