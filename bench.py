@@ -3359,7 +3359,13 @@ def _bench_obs(num_slots: int = 4, n_requests: int = 8,
     ``disarmed_overhead_pct`` compares two independent disarmed
     measurements — the pre-telemetry code path no longer exists, so the
     disarmed claim is pinned as "indistinguishable from itself"
-    (repeat-run variance bounds the None-check cost).
+    (repeat-run variance bounds the None-check cost). The tracing leg
+    (``tracing_overhead_pct``) serves the same armed trace and then
+    runs the PR 19 post-hoc fold — ``request_traces()`` assembly plus
+    the stitched Chrome export — pricing end-to-end request tracing
+    inside the same few-percent armed budget (the fold is offline; its
+    cost is reported separately as ``trace_assembly_ms`` /
+    ``trace_export_ms``).
 
     Train side (reported, not gated): median batch-to-batch interval of
     a BoringModel fit with a bare timing probe vs
@@ -3431,6 +3437,23 @@ def _bench_obs(num_slots: int = 4, n_requests: int = 8,
     tps_armed = max(run(t) for t in armed_tels)
     events_recorded = armed_tels[0].bus.tick
 
+    # --- tracing leg: armed serve + per-request span-tree assembly ------
+    # the serve loop is byte-for-byte the armed one (tracing adds only
+    # the per-event t/sync payload fields already measured above); what
+    # this leg prices is the OFFLINE fold — request_traces() + the
+    # stitched Chrome export — which must stay post-hoc, never on the
+    # dispatch path
+    traced_tels = [armed() for _ in range(repeats)]
+    tps_traced = max(run(t) for t in traced_tels)
+    t0 = time.perf_counter()
+    req_traces = traced_tels[0].request_traces()
+    trace_assembly_ms = (time.perf_counter() - t0) * 1e3
+    from ray_lightning_tpu.obs.tracing import export_fleet_chrome_trace
+    t0 = time.perf_counter()
+    export_fleet_chrome_trace(os.path.join(tmp, "trace.json"),
+                              traced_tels[0], req_traces)
+    trace_export_ms = (time.perf_counter() - t0) * 1e3
+
     # --- train side: bare probe vs StepStatsCallback --------------------
     from ray_lightning_tpu import (RayStrategy, StepStatsCallback, Trainer)
     from ray_lightning_tpu.core.callbacks import Callback
@@ -3466,6 +3489,12 @@ def _bench_obs(num_slots: int = 4, n_requests: int = 8,
         "serve_tokens_per_sec_armed": round(tps_armed, 0),
         "obs_overhead_pct": round(
             100.0 * (tps_disarmed / tps_armed - 1.0), 2),
+        "serve_tokens_per_sec_traced": round(tps_traced, 0),
+        "tracing_overhead_pct": round(
+            100.0 * (tps_disarmed / tps_traced - 1.0), 2),
+        "traces_assembled": len(req_traces),
+        "trace_assembly_ms": round(trace_assembly_ms, 3),
+        "trace_export_ms": round(trace_export_ms, 3),
         "disarmed_overhead_pct": round(
             100.0 * (tps_disarmed / tps_disarmed_b - 1.0), 2),
         "events_recorded": int(events_recorded),

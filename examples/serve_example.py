@@ -14,6 +14,9 @@ requests hand their KV slot to the next one without any recompilation.
         --tenant-classes 'fast:interactive@tuned,bulk:batch'
         # batched multi-LoRA: adapter rows + base rows in one dispatch,
         # class 'fast' bound to the adapter with no per-request flag
+    python examples/serve_example.py --fleet-replicas 2 \
+        --trace-out trace.json   # per-request latency decomposition +
+        # a stitched multi-track Chrome trace (open in Perfetto)
 
 The same trace is replayed as a static batch (one-shot ``generate()``
 that must wait for the LAST arrival before starting) so the makespan
@@ -148,6 +151,16 @@ def main():
                              "queue-transport results, ~15s spawn + "
                              "per-worker compile on CPU — "
                              "docs/serving.md#replica-fleet).")
+    parser.add_argument("--trace-out", default=None, metavar="PATH",
+                        help="arm telemetry and export the stitched "
+                             "Chrome trace of the serve run to PATH "
+                             "(request latency segments + engine spans; "
+                             "multi-track pid=replica seat / tid=KV "
+                             "slot with --fleet-replicas; open in "
+                             "chrome://tracing or Perfetto). Also "
+                             "prints the per-request latency "
+                             "decomposition — see "
+                             "docs/observability.md#request-tracing.")
     parser.add_argument("--max-epochs", type=int, default=1)
     args = parser.parse_args()
     if args.fleet_backend == "process" and not args.fleet_replicas:
@@ -303,6 +316,12 @@ def main():
                 lora_rank=lora_rank) if adapters else {}),
         scheduler_config=SchedulerConfig(
             prefill_priority=args.prefill_priority))
+    # --trace-out arms telemetry: events assemble into per-request span
+    # trees and the whole run exports as one Chrome trace
+    tel = None
+    if args.trace_out:
+        from ray_lightning_tpu.obs import Telemetry
+        tel = Telemetry()
     unit, ufmt = "ticks", ".0f"
     if args.fleet_replicas:
         from ray_lightning_tpu.serve import ReplicaFleet
@@ -314,21 +333,27 @@ def main():
             unit, ufmt = "s", ".2f"
         fleet = ReplicaFleet(dec, params, backend=args.fleet_backend,
                              num_replicas=args.fleet_replicas,
-                             **engine_kw)
+                             telemetry=tel, **engine_kw)
         t0 = time.perf_counter()
         out = fleet.serve_trace(trace)
         serve_wall = time.perf_counter() - t0
         detail = (f"{args.fleet_replicas} {args.fleet_backend} replicas"
                   + (f", dispatch turns {fleet.replica_steps}" if wall
                      else ""))
+        if tel is not None:
+            fleet.export_fleet_trace(args.trace_out)
         fleet.shutdown()
     else:
-        client = ServeClient(dec, params, **engine_kw)
+        client = ServeClient(dec, params, telemetry=tel, **engine_kw)
         t0 = time.perf_counter()
         out = client.serve_trace(trace)
         serve_wall = time.perf_counter() - t0
         detail = (f"{client.engine.prefills} prefills, "
                   f"{client.engine.steps} decode steps")
+        if tel is not None:
+            from ray_lightning_tpu.obs.tracing import \
+                export_fleet_chrome_trace
+            export_fleet_chrome_trace(args.trace_out, tel)
     total_tokens = sum(len(c.tokens) for c in out.values())
 
     print(f"\nserved {len(out)} requests / {total_tokens} tokens in "
@@ -341,6 +366,12 @@ def main():
               f"{len(c.tokens):2d} generated ({c.finish_reason}), "
               f"latency {c.latency:{ufmt}} {unit}, "
               f"ttft {c.time_to_first_token:{ufmt}} {unit}{cls}{ad}")
+
+    if tel is not None:
+        from ray_lightning_tpu.obs.tracing import format_decomposition
+        print(f"\nper-request latency decomposition ({unit}) — Chrome "
+              f"trace exported to {args.trace_out}:")
+        print(format_decomposition(tel.request_traces()))
 
     if tenant_classes:
         # per-class rollup: interactive classes should show the lower

@@ -33,6 +33,15 @@ one channel):
   forwarding: events and metric updates re-emitted verbatim into the
   driver's Telemetry by the fleet (per-replica gauges keep their
   ``replica<id>_`` prefix, stamped worker-side).
+- ``(MSG_SPAN, replica_id, name, ts_us, dur_us, depth, args)`` — one
+  CLOSED worker-side span, stamped on the shared fleet timeline (µs
+  since the driver's epoch). Only shipped when the driver armed
+  telemetry at spawn (``forward_spans=True``) — a disarmed fleet's
+  workers keep returning no-op spans, the zero-cost contract. The
+  driver imports these into its SpanRecorder with the seat tagged
+  (``record_closed``), which is how a dead replica's last flushed
+  spans survive a kill -9: they ride the same death-surviving manager
+  queue as everything else and are harvested by the failover drain.
 - ``(MSG_CRASH, replica_id, "ExcType: detail", implicated_ids)`` — the
   dispatch loop raised; the engine state is unknown and the driver
   fails the replica over (``replica.error`` unless the process also
@@ -67,6 +76,7 @@ MSG_PROGRESS = "progress"
 MSG_STATUS = "status"
 MSG_EVENT = "event"
 MSG_METRIC = "metric"
+MSG_SPAN = "span"
 MSG_CRASH = "crash"
 
 #: env var stamped into every serve worker: which spawn seat this
@@ -135,22 +145,65 @@ class _NullSpan:
         return False
 
 
+class _ForwardSpan:
+    """One worker-side REAL span: measures ``[ts, ts+dur]`` on the
+    shared fleet timeline (µs since the driver's epoch — the same
+    origin the worker's request stamps use) and appends the closed span
+    as one ``MSG_SPAN`` message when it exits, so it rides the next
+    turn's flush batch. Depth comes from the façade's own open-span
+    counter (the dispatch loop is single-threaded, LIFO by
+    construction)."""
+
+    __slots__ = ("_tel", "_name", "_args", "_t0", "_depth")
+
+    def __init__(self, tel: "_ForwardTelemetry", name: str,
+                 args: Dict[str, Any]):
+        self._tel = tel
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_ForwardSpan":
+        self._depth = self._tel._depth
+        self._tel._depth += 1
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc_info) -> bool:
+        t1 = time.time()
+        tel = self._tel
+        tel._depth -= 1
+        tel._buf.append((MSG_SPAN, tel._rid, self._name,
+                         (self._t0 - tel._epoch) * 1e6,
+                         (t1 - self._t0) * 1e6, self._depth,
+                         self._args))
+        return False
+
+
 class _ForwardTelemetry:
     """Telemetry façade handed to the worker's ServeClient: events and
     metric updates buffer locally and flush to the driver once per
-    dispatch turn. Spans are dropped (they are a driver-side profiling
-    surface; the serve loop does not open any)."""
+    dispatch turn. Spans are real only when the driver armed telemetry
+    at spawn (``forward_spans=True``) — they close worker-side and ship
+    as ``MSG_SPAN`` messages for the driver's SpanRecorder; a disarmed
+    fleet's workers keep the no-op span, preserving the zero-cost
+    contract."""
 
-    def __init__(self, buf: List, rid: int):
+    def __init__(self, buf: List, rid: int, epoch: float = 0.0,
+                 forward_spans: bool = False):
         self._buf = buf
         self.metrics = _ForwardMetrics(buf, rid)
         self._rid = rid
+        self._epoch = epoch
+        self._forward_spans = forward_spans
+        self._depth = 0
 
     def event(self, site: str, /, **payload: Any) -> None:
         self._buf.append((MSG_EVENT, self._rid, site, payload))
 
-    def span(self, name: str, **args: Any) -> _NullSpan:
-        return _NullSpan()
+    def span(self, name: str, **args: Any):
+        if not self._forward_spans:
+            return _NullSpan()
+        return _ForwardSpan(self, name, args)
 
     def flush(self) -> None:
         pass
@@ -194,7 +247,8 @@ class ServeReplicaWorker:
                  out_queue: Any, heartbeat_channel: Any,
                  epoch: float, poll_s: float = 0.002,
                  heartbeat_interval: float = 0.02,
-                 fault_plan: Any = None):
+                 fault_plan: Any = None,
+                 forward_spans: bool = False):
         from ray_lightning_tpu.serve.client import ServeClient
         if fault_plan is not None:
             # the driver's armed FaultPlan crosses the construct pickle
@@ -216,7 +270,8 @@ class ServeReplicaWorker:
         # — the single-timeline contract the in-process fleet gets from
         # clock_epoch=0.0 on a shared clock callable, kept across a
         # real process boundary by sharing the origin instead
-        self._tel = _ForwardTelemetry(self._buf, -1)
+        self._tel = _ForwardTelemetry(self._buf, -1, epoch=epoch,
+                                      forward_spans=forward_spans)
         self.client = ServeClient(model, params, clock=time.time,
                                   clock_epoch=epoch, telemetry=self._tel,
                                   **engine_kwargs)

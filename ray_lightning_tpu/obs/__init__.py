@@ -41,7 +41,7 @@ time, so the same workload writes a byte-identical JSONL log every run;
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ray_lightning_tpu.obs.events import Event, EventBus, JsonlSink
 from ray_lightning_tpu.obs.metrics import (Counter, Gauge, Histogram,
@@ -71,6 +71,12 @@ class Telemetry:
                             flush_every=flush_every)
         self.metrics = MetricsRegistry()
         self.spans = SpanRecorder(clock=clock)
+        # ring-overflow drops surface as a counter so truncated traces
+        # are visible in snapshot()/Prometheus, not just on the bus
+        self.bus._drop_hook = self.metrics.counter(
+            "obs_events_dropped_total",
+            help="events evicted from the in-memory ring before being "
+                 "read (the JSONL sink, when armed, still has them)").inc
 
     # ------------------------------------------------------ conveniences
     def event(self, site: str, /, **payload: Any) -> Event:
@@ -84,6 +90,16 @@ class Telemetry:
 
     def flush(self) -> None:
         self.bus.flush()
+
+    # -------------------------------------------------------- tracing
+    def request_traces(self) -> "Dict[int, Any]":
+        """Assemble per-request traces from the event ring — one
+        :class:`~ray_lightning_tpu.obs.tracing.RequestTrace` per request
+        id, with the queue/prefill/decode/sync/failover latency
+        decomposition. See ``docs/observability.md`` ("Request
+        tracing")."""
+        from ray_lightning_tpu.obs.tracing import assemble_request_traces
+        return assemble_request_traces(self.bus.events())
 
     # --------------------------------------------------------- global
     def activated(self) -> "_Activated":

@@ -120,6 +120,12 @@ class EventBus:
         self._flush_every = max(1, flush_every)
         self._sink = (JsonlSink(jsonl_path, rotate_bytes)
                       if jsonl_path else None)
+        # ring-overflow accounting, mirroring SpanRecorder.dropped: the
+        # sink (when armed) still keeps every line — drops only truncate
+        # the in-memory ring that probes and the trace assembler read.
+        self.dropped = 0
+        self._warned_drop = False
+        self._drop_hook: Optional[Callable[[], Any]] = None
 
     @property
     def tick(self) -> int:
@@ -134,6 +140,9 @@ class EventBus:
         # the ring (local probes still work) but lose the sink.
         state = self.__dict__.copy()
         state["_sink"] = None
+        # the hook closes over the DRIVER's metrics registry — a worker
+        # copy incrementing it would double-count (and may not pickle)
+        state["_drop_hook"] = None
         return state
 
     def emit(self, site: str, /, **payload: Any) -> Event:
@@ -148,11 +157,22 @@ class EventBus:
         ev = Event(site=site, tick=self._tick, wall_ms=wall_ms,
                    payload=payload)
         self._tick += 1
+        evicting = len(self._ring) == self._ring.maxlen
+        if evicting:
+            self.dropped += 1
+            if self._drop_hook is not None:
+                self._drop_hook()
         self._ring.append(ev)
         if self._sink is not None:
             self._sink.write(ev.to_json())
             if self._tick % self._flush_every == 0:
                 self._sink.flush()
+        if evicting and not self._warned_drop:
+            # one-shot, so a truncated ring is self-describing; the flag
+            # flips BEFORE the nested emit (which itself evicts one more
+            # ring entry, counted like any other) to bound the recursion
+            self._warned_drop = True
+            self.emit("obs.events_dropped", capacity=self._ring.maxlen)
         return ev
 
     def events(self, site: Optional[str] = None) -> List[Event]:

@@ -106,6 +106,22 @@ class SpanRecorder:
             del self._closed[0]
             self.dropped += 1
 
+    def record_closed(self, name: str, ts: float, dur: float,
+                      depth: int = 0,
+                      args: Optional[Dict[str, Any]] = None) -> None:
+        """Import one already-closed span measured elsewhere — the
+        process-backend ``MSG_SPAN`` leg lands here: a worker stamped
+        ``[ts, ts+dur]`` on the shared fleet timeline (µs since the
+        fleet epoch) and shipped the closed span over the manager queue.
+        Imported spans keep their own timestamps (they are NOT re-zeroed
+        against this recorder's ``_t0``) and respect the same capacity /
+        ``dropped`` accounting as locally recorded spans."""
+        self._closed.append(Span(name=name, ts=float(ts), dur=float(dur),
+                                 depth=int(depth), args=dict(args or {})))
+        if len(self._closed) > self._capacity:
+            del self._closed[0]
+            self.dropped += 1
+
     @property
     def open_depth(self) -> int:
         return len(self._stack)

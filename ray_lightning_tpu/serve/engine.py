@@ -170,6 +170,10 @@ class PendingDispatch:
     rejected: object = None    # spec only: (rounds, B) real divergences
     asynchronous: bool = True  # False: the sync step() round-trip
     enqueued_at: float = 0.0   # host perf_counter stamp (overlap metric)
+    # client-clock enqueue stamp (ticks or seconds), set by an ARMED
+    # ServeClient only — read back at step_sync to split decode time
+    # from reconciliation in request traces (serve.retire `sync`)
+    enqueued_tick: Optional[float] = None
 
 
 # shared serve-program plumbing (one copy for engine + spec programs)
@@ -895,6 +899,11 @@ class ServeEngine:
         # off by default; one attribute read + None check per dispatch
         # when disarmed (docs/observability.md)
         self._tel = telemetry
+        # extra args splatted into every engine span — a ReplicaFleet
+        # stamps {"seat": replica_id} here so the stitched fleet trace
+        # (obs/tracing.py) can put each replica on its own pid track;
+        # empty for a standalone engine (span args unchanged)
+        self._span_extra: Dict[str, Any] = {}
         self.kv_dtype = kv_dtype
         check_kv_dtype(kv_dtype)
         self.paged = page_size is not None
@@ -1524,7 +1533,8 @@ class ServeEngine:
         # the traced programs byte-for-byte the pre-LoRA ones, and model
         # families without the adapter_ids kwarg never see it
         adapter_arg = adapter_row if self._registry is not None else None
-        with (tel.span("engine.prefill", n=len(batched))
+        with (tel.span("engine.prefill", n=len(batched),
+                       **self._span_extra)
               if tel is not None else NULL_SPAN):
             if self.paged:
                 fn = _pick(_prefill_paged_donated, _prefill_paged_plain)
@@ -1585,7 +1595,8 @@ class ServeEngine:
         adapter_arg = (np.array([self._adapter_ids[st.slot]], np.int32)
                        if self._registry is not None else None)
         fn = _pick(_chunk_prefill_donated, _chunk_prefill_plain)
-        with (tel.span("engine.chunk", id=req.id, off=off, n=valid)
+        with (tel.span("engine.chunk", id=req.id, off=off, n=valid,
+                       slot=st.slot, **self._span_extra)
               if tel is not None else NULL_SPAN):
             self.pool.arena, first = fn(
                 self.model, self.params, self.pool.arena, row_pages,
@@ -1707,7 +1718,8 @@ class ServeEngine:
         faults.poison_check(self.pool.active.values())
         tel = self._tel
         cur, pos, active, remaining, stepno = self._carry_in()
-        with (tel.span("engine.step", active=int(self._active.sum()))
+        with (tel.span("engine.step", active=int(self._active.sum()),
+                       **self._span_extra)
               if tel is not None else NULL_SPAN):
             if self.paged and self.page_native:
                 # page-native: attention reads/writes K/V through the
@@ -1889,7 +1901,8 @@ class ServeEngine:
         k, rounds = spec.k, self.steps_per_dispatch
         cur, pos, act, remaining, stepno = self._carry_in()
         with (tel.span("engine.spec_round", active=int(self._active.sum()),
-                       k=k) if tel is not None else NULL_SPAN):
+                       k=k, **self._span_extra)
+              if tel is not None else NULL_SPAN):
             if self.paged and self.page_native:
                 # the widened verify reads/writes target K/V through
                 # the page table too — spec and page-native compose on

@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -449,6 +450,13 @@ class _Replica:
         # stay coherent across failovers; a standby promoted here gets
         # its prefix at adoption time, before its first dispatch.
         client.gauge_prefix = f"replica{replica_id}_"
+        # seat-tag engine spans so the stitched fleet Chrome trace
+        # (obs/tracing.py) puts this replica on its own pid track; the
+        # getattr guard keeps duck-typed clients (process-backend
+        # proxies have no local engine) working
+        engine = getattr(client, "engine", None)
+        if engine is not None and hasattr(engine, "_span_extra"):
+            engine._span_extra = {"seat": replica_id}
         self.draining = False   # scale-in: finish in-flight, admit nothing
         self.stalled = False    # latched wedge (serve.replica stall fault)
         # carried beat state: the monitor is rebuilt on membership
@@ -1479,6 +1487,52 @@ class ReplicaFleet:
                 raise RuntimeError(
                     f"fleet trace did not drain in {max_ticks} ticks")
         return dict(self.completions)
+
+    # ------------------------------------------------------ observability
+    #: internal per-replica gauge prefix -> fleet-merged suffix form
+    _REPLICA_GAUGE_RE = re.compile(r"^replica(\d+)_(serve_.+)$")
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Fleet-merged metrics view: the registry's internal
+        ``replica<id>_serve_*`` gauge keying (which exists to stop
+        per-replica gauges clobbering each other last-writer-wins) is
+        renamed to the seat-suffixed operator form —
+        ``serve_queue_depth_r0``, ``serve_slot_occupancy_r1``, … —
+        alongside the untouched ``serve_fleet_*`` aggregates and every
+        other metric. Same shape on both backends (process-backend
+        replica gauges are forwarded into the same registry under the
+        same prefix). ``{}`` when the fleet was built disarmed."""
+        if self._tel is None:
+            return {}
+        out: Dict[str, Any] = {}
+        for name, value in self._tel.metrics.snapshot().items():
+            m = self._REPLICA_GAUGE_RE.match(name)
+            out[f"{m.group(2)}_r{m.group(1)}" if m else name] = value
+        return out
+
+    def request_traces(self) -> Dict[int, Any]:
+        """Assembled per-request traces for this fleet run — see
+        :meth:`Telemetry.request_traces`. ``{}`` when disarmed."""
+        if self._tel is None:
+            return {}
+        return self._tel.request_traces()
+
+    def export_fleet_trace(self, path: str) -> str:
+        """Stitch every replica's spans (in-process seat-tagged, or
+        shipped over the process backend's ``MSG_SPAN`` leg) together
+        with the per-request latency segments into ONE multi-track
+        Chrome trace (``pid`` = replica seat, ``tid`` = KV slot) and
+        atomically publish it at ``path``. Byte-identical across
+        identical runs under the tick clock. Raises ``RuntimeError``
+        when the fleet was built with ``telemetry=None`` — there is
+        nothing to export, and silently writing an empty file would
+        mask a mis-armed run."""
+        if self._tel is None:
+            raise RuntimeError(
+                "export_fleet_trace on a disarmed fleet: pass "
+                "telemetry= at construction to record a trace")
+        from ray_lightning_tpu.obs.tracing import export_fleet_chrome_trace
+        return export_fleet_chrome_trace(path, self._tel)
 
     # ---------------------------------------------------------- teardown
     def shutdown(self) -> None:

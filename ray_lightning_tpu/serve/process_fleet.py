@@ -443,7 +443,10 @@ class ProcessReplicaFleet(ReplicaFleet):
             # ship the driver's armed fault plan (if any) so worker-side
             # engines fire the same sites — chaos drills (and the
             # poison leg of the bench) hold identically on this backend
-            fault_plan=faults.get_armed())
+            fault_plan=faults.get_armed(),
+            # real worker-side spans (MSG_SPAN) only when the driver is
+            # armed: a disarmed fleet's workers keep the no-op span
+            forward_spans=self._tel is not None)
 
     def _activate(self, handle: Any) -> _ProcessReplica:
         rid = self._next_replica_id
@@ -646,7 +649,7 @@ class ProcessReplicaFleet(ReplicaFleet):
     def _drain_messages(self, done: List[Completion]) -> None:
         from ray_lightning_tpu.launchers.serve_worker import (
             MSG_COMPLETION, MSG_CRASH, MSG_EVENT, MSG_METRIC,
-            MSG_PROGRESS, MSG_STATUS)
+            MSG_PROGRESS, MSG_SPAN, MSG_STATUS)
         by_id = {rep.id: rep for rep in self._replicas}
         while True:
             try:
@@ -681,6 +684,17 @@ class ProcessReplicaFleet(ReplicaFleet):
                 elif mk == MSG_METRIC:
                     if self._tel is not None:
                         self._apply_metric(msg)
+                elif mk == MSG_SPAN:
+                    if self._tel is not None:
+                        # a worker's closed span (fleet-timeline µs):
+                        # import seat-tagged so the stitched Chrome
+                        # trace puts each replica on its own pid track.
+                        # A dead replica's last flushed spans land here
+                        # too — _fail_replica drains before teardown.
+                        _mk, srid, name, ts, dur, depth, args = msg
+                        self._tel.spans.record_closed(
+                            name, ts, dur, depth,
+                            dict(args, seat=srid))
                 elif mk == MSG_CRASH:
                     if rep is not None:
                         rep.crashed = True

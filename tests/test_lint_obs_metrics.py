@@ -1,0 +1,110 @@
+"""Lint: every metric name emitted by library code is documented.
+
+The metric half of the ``test_lint_obs_docs.py`` contract (PR 12's
+doc-drift class): ``docs/observability.md`` promises a complete metric
+name table — operators grep it to find what a Prometheus series means —
+so this lint walks the library AST and collects every NAME that can
+reach the registry:
+
+- string literals passed to ``*.counter(...)`` / ``*.gauge(...)`` /
+  ``*.histogram(...)`` — asserted to appear verbatim in the doc;
+- keyed/dynamic names (f-strings like
+  ``f"serve_tenant_ttft_ms_{comp.tenant}"`` and gauge-prefix concats
+  like ``self.gauge_prefix + "serve_queue_depth"``): their first
+  constant fragment (the stable prefix/stem) must appear as a substring
+  — the doc rows spell them ``serve_tenant_ttft_ms_<class>`` etc.;
+- module-level ``GAUGE_*``/``COUNTER_*``/``HISTOGRAM_*`` constants
+  (sites that pass a constant are covered by its definition).
+
+A new metric lands in the docs table or this lint fails.
+"""
+import ast
+import pathlib
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PKG = ROOT / "ray_lightning_tpu"
+DOC = ROOT / "docs" / "observability.md"
+
+METRIC_ATTRS = {"counter", "gauge", "histogram"}
+CONST_PREFIXES = ("GAUGE_", "COUNTER_", "HISTOGRAM_")
+
+
+def _constant_fragments(node):
+    """Constant string pieces of a name expression, left to right."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.JoinedStr):
+        return [v.value for v in node.values
+                if isinstance(v, ast.Constant)
+                and isinstance(v.value, str)]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return (_constant_fragments(node.left)
+                + _constant_fragments(node.right))
+    return []
+
+
+def _collect():
+    literals = {}   # full metric name -> first "path:line" site
+    prefixes = {}   # keyed-name stable stem -> first "path:line" site
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        rel = path.relative_to(ROOT)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRIC_ATTRS and node.args):
+                arg = node.args[0]
+                site = f"{rel}:{node.lineno}"
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    literals.setdefault(arg.value, site)
+                else:
+                    frags = _constant_fragments(arg)
+                    if frags and frags[0]:
+                        # the FIRST fragment is the stable stem the doc
+                        # spells with a <placeholder> suffix; trailing
+                        # fragments ("_total", "_s") are not names
+                        prefixes.setdefault(frags[0], site)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name)
+                            and tgt.id.startswith(CONST_PREFIXES)
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, str)):
+                        literals.setdefault(node.value.value,
+                                            f"{rel}:{node.lineno}")
+    return literals, prefixes
+
+
+LITERALS, PREFIXES = _collect()
+
+
+def test_metric_names_discovered():
+    # sanity: the walker sees every emission shape (a refactor that
+    # changes them must update this lint, not silently stop collecting)
+    assert "serve_requests_total" in LITERALS        # plain literal
+    assert "serve_fleet_replicas_live" in LITERALS   # GAUGE_* constant
+    assert "obs_events_dropped_total" in LITERALS    # bus drop counter
+    assert "serve_tenant_ttft_ms_" in PREFIXES       # keyed f-string
+    assert "serve_queue_depth" in PREFIXES           # gauge_prefix concat
+    assert len(LITERALS) >= 30
+    assert len(PREFIXES) >= 8
+
+
+@pytest.mark.parametrize("name", sorted(LITERALS), ids=str)
+def test_every_metric_name_is_documented(name):
+    assert name in DOC.read_text(), (
+        f"metric {name!r} (registered at {LITERALS[name]}) is missing "
+        "from docs/observability.md — every metric name that reaches "
+        "the registry must have a row in its metric tables")
+
+
+@pytest.mark.parametrize("prefix", sorted(PREFIXES), ids=str)
+def test_every_keyed_metric_prefix_is_documented(prefix):
+    assert prefix in DOC.read_text(), (
+        f"keyed metric family {prefix!r}* (registered at "
+        f"{PREFIXES[prefix]}) is missing from docs/observability.md — "
+        "document it with a <placeholder> suffix, e.g. "
+        f"`{prefix}<class>`")

@@ -37,10 +37,40 @@ def test_event_bus_ring_and_ticks():
     bus = EventBus(capacity=3)
     for i in range(5):
         bus.emit("a.site", i=i)
-    evs = bus.events()
-    assert [e.payload["i"] for e in evs] == [2, 3, 4]  # bounded ring
-    assert [e.tick for e in evs] == [2, 3, 4]          # ticks keep counting
-    assert bus.tick == 5
+    evs = bus.events("a.site")
+    # bounded ring: the first overflow also emits the one-shot
+    # obs.events_dropped warning (which displaces one more entry)
+    assert [e.payload["i"] for e in evs] == [3, 4]
+    assert [e.site for e in bus.events()] == ["a.site", "obs.events_dropped",
+                                              "a.site"]
+    assert [e.tick for e in evs] == [3, 5]  # ticks keep counting
+    assert bus.tick == 6  # 5 payloads + the warning
+    # drop accounting: i=0 (first overflow), i=1 (the warning's own
+    # eviction), i=2 (the last emit)
+    assert bus.dropped == 3
+
+
+def test_event_bus_drop_counter_and_one_shot_warning():
+    tel = Telemetry(capacity=2)
+    # pre-registered at 0 so the series is present before any drop
+    assert tel.metrics.snapshot()["obs_events_dropped_total"] == 0.0
+    tel.event("a", i=0)
+    tel.event("a", i=1)
+    assert tel.bus.dropped == 0
+    tel.event("a", i=2)  # first overflow: warn once, count twice
+    warns = tel.events("obs.events_dropped")
+    assert len(warns) == 1 and warns[0].payload["capacity"] == 2
+    before = tel.bus.dropped
+    tel.event("a", i=3)
+    tel.event("a", i=4)
+    # no second warning is EMITTED (the first may itself rotate out of
+    # the bounded ring — one-shot-ness is about emission, not retention)
+    assert tel.events() and all(
+        e.site != "obs.events_dropped" or e.tick == warns[0].tick
+        for e in tel.events())
+    assert tel.bus.dropped == before + 2
+    assert (tel.metrics.snapshot()["obs_events_dropped_total"]
+            == float(tel.bus.dropped))
 
 
 def test_event_bus_site_filter():

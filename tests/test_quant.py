@@ -325,4 +325,7 @@ def test_weights_quantized_obs_pinned(nano):
     _run(dec, params, weight_dtype="int8", draft_model=draft,
          draft_params=dparams, spec_k=2)
     assert not fresh.events()
-    assert fresh.metrics.snapshot() == {}
+    # the only series on a fresh handle is the pre-registered
+    # ring-drop counter (PR 19), still at zero
+    assert fresh.metrics.snapshot() == {
+        "obs_events_dropped_total": 0.0}
