@@ -2837,6 +2837,163 @@ def _bench_chaos_poison(num_replicas: int = 3, n_requests: int = 9,
     }
 
 
+def _bench_driver_restart(num_slots: int = 4, prompt: int = 24,
+                          new_tokens: int = 24,
+                          steps_per_dispatch: int = 4,
+                          kill_tick: int = 5) -> dict:
+    """Driver-death survival: journal write tax + warm-restart cost (PR 20).
+
+    A ``num_slots`` all-at-once burst (GPT-2-small, **fp32** — restart
+    identity must be checkable token-for-token, the ``_bench_fleet``
+    rule; greedy AND sampled rows) served three ways: disarmed
+    (``journal=None`` baseline), journal-armed at maximum durability
+    (``sync_every=1`` — every record fsync'd, the worst-case write
+    tax recorded as ``journal_overhead_pct``), and journal-armed under
+    a seeded mid-decode driver kill (``FaultPlan.at("serve.driver",
+    [kill_tick])`` — the in-process stand-in for SIGKILL; the real-kill
+    path is pinned by ``tests/test_journal.py``). The kill leg then
+    warm-restarts via :meth:`ServeClient.restore` and decomposes the
+    cost: ``restore_rebuild_ms`` (fold the WAL + build the cold engine
+    + re-admit) vs ``restore_replay_ms`` (re-feed every journaled
+    ``prompt + frontier`` through prefill until each replayed request
+    is back at its kill-point frontier).
+
+    ENFORCED, not just recorded — a violation raises
+    :class:`MeasurementError`: the merged pre-kill + post-restore
+    output must have **zero** token mismatches against the clean run,
+    the dead driver's completions and the restored driver's must not
+    overlap (no double emission), and the final journal must fold with
+    **zero** duplicate retirements. Untracked (restore cost is
+    dominated by engine rebuild/compile behavior, the
+    ``_bench_chaos`` rule)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import gpt2_config
+    from ray_lightning_tpu.models.transformer import TransformerLM
+    from ray_lightning_tpu.reliability import FaultPlan
+    from ray_lightning_tpu.reliability.faults import InjectedFault
+    from ray_lightning_tpu.serve import (Journal, ServeClient,
+                                         read_journal)
+
+    total = prompt + new_tokens
+    base = dict(vocab_size=50304, max_seq_len=total, dtype=jnp.float32,
+                scan_layers=False)
+    model = TransformerLM(gpt2_config("small", **base))
+    toks0 = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50257, size=(num_slots, prompt)), jnp.int32)
+    params = jax.device_put(
+        model.init(jax.random.PRNGKey(0), toks0)["params"])
+    dec = TransformerLM(gpt2_config("small", decode=True, **base))
+
+    rng = np.random.default_rng(20)
+    trace = []
+    for i in range(num_slots):  # one burst, everything seats at tick 1
+        L = int(rng.integers(prompt // 2, prompt + 1))
+        trace.append((0.0, dict(
+            prompt=[int(t) for t in rng.integers(0, 50257, size=L)],
+            max_new_tokens=int(rng.integers(new_tokens // 2,
+                                            new_tokens + 1)),
+            temperature=0.0 if i % 2 == 0 else 0.8,
+            top_k=None if i % 2 == 0 else 20,
+            seed=100 + i)))
+
+    # prefill_len covers prompt + full budget: restart replays a
+    # request as prompt + journaled frontier through ONE prefill pass
+    # (the docs/reliability.md replay-window sizing rule)
+    kw = dict(num_slots=num_slots, prefill_len=total,
+              steps_per_dispatch=steps_per_dispatch,
+              clock=time.perf_counter)
+
+    def run(journal=None):
+        client = ServeClient(dec, params, journal=journal, **kw)
+        out = client.serve_trace(trace)
+        return client, out, max(c.finish_time for c in out.values())
+
+    run()  # warmup: compiles prefill+inject and the K-step program
+    _, clean_out, clean_makespan = run()
+
+    wal = os.path.join(tempfile.mkdtemp(prefix="tl_bench_wal_"), "j.wal")
+    armed_j = Journal(wal + ".overhead", sync_every=1)
+    armed_client, armed_out, armed_makespan = run(journal=armed_j)
+    armed_client.shutdown()
+    if any(armed_out[r].tokens != clean_out[r].tokens for r in clean_out):
+        raise MeasurementError(
+            "journal-armed run diverged from disarmed — journaling "
+            "must never touch tokens")
+
+    # the kill leg: seeded mid-decode driver death, then warm restart
+    journal = Journal(wal, sync_every=1)
+    kill_client = ServeClient(dec, params, journal=journal, **kw)
+    plan = FaultPlan.at("serve.driver", [kill_tick])
+    try:
+        with plan.armed():
+            kill_client.serve_trace(trace)
+        raise MeasurementError(
+            f"driver kill at tick {kill_tick} never fired — the burst "
+            "drained first; retune _bench_driver_restart knobs")
+    except InjectedFault:
+        pass
+    pre = dict(kill_client.completions)  # already in the caller's hands
+    need = {req.id: len(toks)
+            for req, toks in read_journal(wal).pending()}
+    if not pre or not need:
+        raise MeasurementError(
+            f"kill tick {kill_tick} split nothing ({len(pre)} retired, "
+            f"{len(need)} mid-flight) — retune _bench_driver_restart "
+            "knobs so the kill lands mid-decode")
+
+    t0 = time.perf_counter()
+    restored = ServeClient.restore(wal, dec, params, sync_every=1, **kw)
+    t1 = time.perf_counter()
+    while True:  # replay done: every journaled frontier re-established
+        flight = {req.id: len(toks) for req, toks
+                  in restored.engine.snapshot_in_flight()}
+        if all(rid in restored.completions or flight.get(rid, -1) >= k
+               for rid, k in need.items()):
+            break
+        restored.tick()
+    t2 = time.perf_counter()
+    post = restored.run_until_idle()
+    restored.shutdown()
+
+    if set(pre) & set(post):
+        raise MeasurementError(
+            f"requests {sorted(set(pre) & set(post))} emitted by BOTH "
+            "the dead and the restored driver — exactly-once broke")
+    merged = dict(pre)
+    merged.update(post)
+    mismatched = sum(1 for rid in clean_out
+                     if merged[rid].tokens != clean_out[rid].tokens)
+    final = read_journal(wal)
+    if mismatched or final.duplicate_retires:
+        raise MeasurementError(
+            f"warm restart broke the contract ({mismatched} token "
+            f"mismatches in fp32, {final.duplicate_retires} duplicate "
+            "retirements) — timing numbers would be meaningless")
+
+    return {
+        "model": "gpt2_small (fp32 serving params)",
+        "num_slots": num_slots, "requests": len(trace),
+        "steps_per_dispatch": steps_per_dispatch,
+        "sync_every": 1,
+        "journal_records": armed_j.records,
+        "journal_syncs": armed_j.syncs,
+        "journal_overhead_pct": round(
+            100.0 * (armed_makespan / clean_makespan - 1.0), 1),
+        "kill_tick": kill_tick,
+        "retired_before_kill": len(pre),
+        "replayed_requests": len(need),
+        "restore_rebuild_ms": round(1e3 * (t1 - t0), 1),
+        "restore_replay_ms": round(1e3 * (t2 - t1), 1),
+        "restore_ms": round(1e3 * (t2 - t0), 1),
+        "replay_token_mismatches": mismatched,
+        "duplicate_retirements": final.duplicate_retires,
+    }
+
+
 def _bench_fleet(num_replicas: int = 3, n_requests: int = 12,
                  prompt: int = 32, new_tokens: int = 32,
                  steps_per_dispatch: int = 4) -> dict:
@@ -3998,6 +4155,17 @@ def main() -> None:
             extras["chaos"]["poison"] = _bench_chaos_poison()
     except Exception as exc:
         extras["chaos"]["poison"] = {
+            "error": f"{type(exc).__name__}: {exc}"}
+    try:
+        # PR 20 driver-death leg: journal write tax + a seeded
+        # mid-decode driver kill warm-restarted through the WAL.
+        # ENFORCED — zero token mismatches (fp32) and zero duplicate
+        # retirements across the kill, or the leg raises
+        # MeasurementError. Untracked like the other chaos legs.
+        if isinstance(extras.get("chaos"), dict):
+            extras["chaos"]["driver_restart"] = _bench_driver_restart()
+    except Exception as exc:
+        extras["chaos"]["driver_restart"] = {
             "error": f"{type(exc).__name__}: {exc}"}
     try:
         # replica-fleet serving under a seeded serve.replica kill:

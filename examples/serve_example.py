@@ -17,6 +17,9 @@ requests hand their KV slot to the next one without any recompilation.
     python examples/serve_example.py --fleet-replicas 2 \
         --trace-out trace.json   # per-request latency decomposition +
         # a stitched multi-track Chrome trace (open in Perfetto)
+    python examples/serve_example.py --journal /tmp/serve.wal
+        # driver-death survival: write-ahead journal, a simulated
+        # mid-decode driver kill, warm restart + token-exact replay
 
 The same trace is replayed as a static batch (one-shot ``generate()``
 that must wait for the LAST arrival before starting) so the makespan
@@ -151,6 +154,22 @@ def main():
                              "queue-transport results, ~15s spawn + "
                              "per-worker compile on CPU — "
                              "docs/serving.md#replica-fleet).")
+    parser.add_argument("--journal", default=None, metavar="PATH",
+                        help="arm the write-ahead request journal and "
+                             "demonstrate driver-death survival: serve "
+                             "the trace until a few requests have "
+                             "retired and the rest are mid-decode, "
+                             "abandon the client WITHOUT shutdown (the "
+                             "simulated driver kill — the journal at "
+                             "PATH is all that survives), then "
+                             "ServeClient.restore() rebuilds cold and "
+                             "replays every unretired request from its "
+                             "journaled token frontier. The greedy "
+                             "generate() identity check runs on the "
+                             "merged pre-kill + post-restore output "
+                             "(docs/reliability.md). Standalone client "
+                             "only — fleet and real-SIGKILL restores "
+                             "are pinned by tests/test_journal.py.")
     parser.add_argument("--trace-out", default=None, metavar="PATH",
                         help="arm telemetry and export the stitched "
                              "Chrome trace of the serve run to PATH "
@@ -165,6 +184,9 @@ def main():
     args = parser.parse_args()
     if args.fleet_backend == "process" and not args.fleet_replicas:
         parser.error("--fleet-backend process needs --fleet-replicas N")
+    if args.journal and args.fleet_replicas:
+        parser.error("--journal demos the standalone-client restart "
+                     "(fleet warm restarts: tests/test_journal.py)")
     if args.matmul_kernel == "pallas" and args.weight_dtype is None:
         parser.error("--matmul-kernel pallas needs --weight-dtype "
                      "(the fused kernel consumes quantized codes)")
@@ -343,6 +365,55 @@ def main():
         if tel is not None:
             fleet.export_fleet_trace(args.trace_out)
         fleet.shutdown()
+    elif args.journal:
+        from ray_lightning_tpu.serve import Journal, read_journal
+        # every possible kill-point frontier must fit the replay window
+        # (prompt + already-emitted tokens re-feed through ONE prefill
+        # pass), so widen the compiled prefill to prompt + full budget
+        jkw = dict(engine_kw,
+                   prefill_len=args.prefill_len + args.max_new)
+        client = ServeClient(dec, params, telemetry=tel,
+                             journal=Journal(args.journal, sync_every=1),
+                             **jkw)
+        t0 = time.perf_counter()
+        arrivals = list(trace)
+        tick = submitted = 0
+        while True:
+            while arrivals and arrivals[0][0] <= tick:
+                client.submit(**arrivals.pop(0)[1])
+                submitted += 1
+            client.tick()
+            tick += 1
+            done = len(client.completions)
+            if done >= 2 and done < submitted:
+                break  # some retired, some mid-decode: kill NOW
+            if submitted == len(trace) and done == submitted:
+                break  # trace drained before the kill point (tiny run)
+        # the "kill": walk away mid-decode — no drain, no shutdown.
+        # Completions already delivered stay in the caller's hands;
+        # the journal on disk is everything the restart gets.
+        pre = dict(client.completions)
+        st = read_journal(args.journal)
+        n_replay = len(st.pending())
+        print(f"\ndriver killed at tick {tick}: {len(pre)} retired, "
+              f"{n_replay} mid-flight, {len(arrivals)} not yet arrived")
+        print("(replayed rows keep their journaled arrival stamps while "
+              "the restarted driver's tick clock restarts at 0, so "
+              "their latency/ttft readouts below can go negative — "
+              "tokens, not clocks, are the identity contract)")
+        restored = ServeClient.restore(args.journal, dec, params,
+                                       telemetry=tel, **jkw)
+        for _, kw in arrivals:  # arrivals the dead driver never saw
+            restored.submit(**kw)
+        out = dict(pre)
+        out.update(restored.run_until_idle())
+        serve_wall = time.perf_counter() - t0
+        detail = (f"driver killed + warm restart replayed {n_replay} "
+                  f"mid-flight requests from {args.journal}")
+        if tel is not None:
+            from ray_lightning_tpu.obs.tracing import \
+                export_fleet_chrome_trace
+            export_fleet_chrome_trace(args.trace_out, tel)
     else:
         client = ServeClient(dec, params, telemetry=tel, **engine_kw)
         t0 = time.perf_counter()

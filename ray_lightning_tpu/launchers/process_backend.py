@@ -33,6 +33,54 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
+#: set in a spawned process's env (by ProcessReplicaFleet) to arm the
+#: orphan guard: a watchdog thread that hard-exits the process once its
+#: parent (the driver) has been gone past this many seconds — so a
+#: SIGKILL'd driver never leaks its worker fleet
+#: (docs/reliability.md#driver-death-survival--warm-restart)
+ORPHAN_GRACE_ENV = "TL_ORPHAN_GRACE_S"
+
+
+def _install_orphan_guard(grace_s: float) -> None:
+    """Start the orphan-reap watchdog in THIS process.
+
+    A SIGKILL'd driver sends no exit message and closes no pipe
+    handles held by grandchildren — but the kernel reparents its
+    children immediately, so a ppid change IS the death signal. The
+    watchdog polls for it; on detection it waits out ``grace_s`` (the
+    window a supervising wrapper would need to re-own us — none does
+    today, the grace exists so transient ptrace/debugger reparenting
+    can never kill a healthy worker) and hard-exits: there is no
+    driver left to unwind toward. Exit code 3 marks an orphan
+    self-reap in postmortems.
+    """
+    parent = os.getppid()
+    poll = max(0.02, min(0.25, grace_s / 4)) if grace_s > 0 else 0.05
+
+    def _watch() -> None:
+        while True:
+            time.sleep(poll)  # tl-lint: allow-sleep — wall-clock watchdog poll; the driver it watches is a real OS process
+            if os.getppid() != parent:
+                if grace_s > 0:
+                    time.sleep(grace_s)  # tl-lint: allow-sleep — the orphan grace window is wall-clock by contract
+                os._exit(3)
+
+    threading.Thread(target=_watch, daemon=True,
+                     name="tl-orphan-guard").start()
+
+
+def install_orphan_guard_from_env() -> Optional[float]:
+    """Arm the orphan guard iff :data:`ORPHAN_GRACE_ENV` is set; returns
+    the grace window (seconds) when armed. Called by every spawned
+    worker after applying its env."""
+    raw = os.environ.get(ORPHAN_GRACE_ENV)
+    if not raw:
+        return None
+    grace_s = float(raw)
+    _install_orphan_guard(grace_s)
+    return grace_s
+
+
 def _worker_main(conn, env: Dict[str, str]) -> None:
     """Actor process body: apply env BEFORE anything initializes a backend,
     then serve construct/call messages over the pipe until exit/EOF."""
@@ -41,6 +89,10 @@ def _worker_main(conn, env: Dict[str, str]) -> None:
     # really os._exit here instead of degrading to a raise
     os.environ.setdefault("TL_WORKER_PROCESS", "1")
     os.environ.update(env)
+    # a pipe EOF already exits this loop when the driver dies cleanly;
+    # the guard covers the SIGKILL shape, where a worker wedged inside
+    # a long call (or blocked on a manager queue) never reads the pipe
+    install_orphan_guard_from_env()
     actor = None
     while True:
         try:
@@ -301,8 +353,13 @@ class _ManagerQueue:
     def __reduce__(self):
         return (_rebuild_manager_queue, (self._q,))
 
-    def put(self, item: Any) -> None:
-        self._q.put(item)
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        # timeout matters worker-side: a put into a dead manager's
+        # proxy raises promptly, but a FULL queue under a dead manager
+        # could block forever — serve workers bound every put to their
+        # orphan grace window (launchers/serve_worker.py)
+        self._q.put(item, block, timeout)
 
     def get(self, block: bool = True, timeout: Optional[float] = None):
         return self._q.get(block, timeout)
@@ -324,10 +381,15 @@ class ProcessRay:
     ObjectRef = ProcessObjectRef
 
     def __init__(self, worker_env: Optional[Dict[str, str]] = None,
-                 serialize_puts: bool = True):
+                 serialize_puts: bool = True,
+                 orphan_grace_s: Optional[float] = None):
         self._initialized = False
         self.worker_env = dict(worker_env or {})
         self.serialize_puts = serialize_puts
+        # arm the manager process's own orphan guard: the SyncManager
+        # child outlives a SIGKILL'd driver exactly like a worker does,
+        # and it holds no pipe to notice the death through
+        self.orphan_grace_s = orphan_grace_s
         self.created_actors: List[ProcessActorHandle] = []
         self.killed_actors: List[ProcessActorHandle] = []
         self._manager = None
@@ -404,5 +466,15 @@ class ProcessRay:
     # -- launcher extension: cross-process tune queue ------------------- #
     def make_queue(self) -> _ManagerQueue:
         if self._manager is None:
-            self._manager = mp.get_context("spawn").Manager()
+            ctx = mp.get_context("spawn")
+            if self.orphan_grace_s is not None:
+                # ctx.Manager() takes no initializer: start the
+                # SyncManager explicitly so its process installs the
+                # orphan guard before serving any proxy
+                from multiprocessing.managers import SyncManager
+                self._manager = SyncManager(ctx=ctx)
+                self._manager.start(_install_orphan_guard,
+                                    (float(self.orphan_grace_s),))
+            else:
+                self._manager = ctx.Manager()
         return _ManagerQueue(self._manager)

@@ -15,10 +15,13 @@ Control-message schema (worker → driver, over the shared manager-hosted
 out-queue; every message carries the replica id so all replicas share
 one channel):
 
-- ``(MSG_BATCH, replica_id, [msg, ...])`` — the only thing actually
-  put on the queue: one per dispatch turn, batching everything below
-  (a manager-queue put is a proxy round-trip; per-emission puts would
-  tax the dispatch hot loop with IPC).
+- ``(MSG_BATCH, replica_id, [msg, ...], generation)`` — the only thing
+  actually put on the queue: one per dispatch turn, batching everything
+  below (a manager-queue put is a proxy round-trip; per-emission puts
+  would tax the dispatch hot loop with IPC). The trailing generation id
+  is the driver-death fence: a warm-restarted driver bumped it, so
+  batches raced over from the dead driver's workers are refused
+  (``journal.stale_dropped``).
 - ``(MSG_COMPLETION, replica_id, Completion)`` — a retired request.
 - ``(MSG_PROGRESS, replica_id, {request_id: {"tokens": [...],
   "first_token_time": t | None}})`` — cumulative emitted tokens for
@@ -55,8 +58,9 @@ one channel):
 Heartbeats do NOT ride the out-queue: the fleet clock rides the
 dedicated heartbeat channel via the gang layer's
 :class:`~...reliability.gang.HeartbeatEmitter` — ``(replica_id, ops,
-worker_monotonic)`` beats, re-stamped with the driver clock on receipt,
-exactly like a training rank. Beats come from the dispatch-loop thread
+worker_monotonic, generation)`` beats (the same trailing fence stamp),
+re-stamped with the driver clock on receipt, exactly like a training
+rank. Beats come from the dispatch-loop thread
 itself (idle turns included), so a wedged dispatch stops beating and the
 driver's :class:`~...reliability.gang.GangMonitor` declares the replica
 hung in bounded time; a background beater thread would defeat that.
@@ -83,6 +87,59 @@ MSG_CRASH = "crash"
 #: process fills (per-seat device/platform env hangs off it — on a TPU
 #: host, ``per_seat_env`` maps a seat to its TPU_VISIBLE_DEVICES slice)
 SEAT_ENV_VAR = "TL_SERVE_SEAT"
+
+
+class _FencedChannel:
+    """Generation-stamped, bounded-put wrapper over a manager queue —
+    the worker half of the driver-death fence
+    (docs/reliability.md#driver-death-survival--warm-restart).
+
+    Every tuple put through it grows the worker's spawn-time
+    **generation id** as its last element, so a restarted driver (which
+    bumped the generation via the journal) can refuse messages that
+    raced over from the dead driver's workers. Every put is **bounded**
+    by a timeout derived from the orphan grace window: a dead manager's
+    proxy raises promptly, but a FULL queue under a dying manager would
+    block a bare ``put`` forever — and a worker wedged inside a queue
+    op never reaches its pipe EOF. Failures never propagate into the
+    dispatch loop (a dying channel must not crash a healthy replica);
+    instead the wrapper tracks how long the channel has been dead and
+    hard-exits the process once the silence outlives the grace window —
+    the heartbeat-channel-silence leg of orphan self-reaping (the ppid
+    watchdog in ``process_backend`` is the other leg)."""
+
+    __slots__ = ("_q", "_gen", "_grace_s", "_timeout", "_first_fail")
+
+    def __init__(self, queue: Any, generation: int,
+                 grace_s: Optional[float] = None):
+        self._q = queue
+        self._gen = int(generation)
+        self._grace_s = grace_s
+        if grace_s is not None and grace_s > 0:
+            self._timeout = max(0.05, min(1.0, grace_s / 4))
+        else:
+            self._timeout = 5.0
+        self._first_fail: Optional[float] = None
+
+    def put(self, item: tuple) -> None:
+        try:
+            self._q.put(tuple(item) + (self._gen,), True, self._timeout)
+        except Exception as exc:  # noqa: BLE001 — worker must outlive the channel
+            from ray_lightning_tpu.reliability import log_suppressed
+            now = time.time()
+            if self._first_fail is None:
+                self._first_fail = now
+            log_suppressed("serve_worker.channel", exc,
+                           "queue put failed; message dropped")
+            if (self._grace_s is not None
+                    and now - self._first_fail >= self._grace_s
+                    and os.environ.get("TL_WORKER_PROCESS")):
+                # the driver (or its manager) has been unreachable for a
+                # whole grace window: this worker is an orphan — reap
+                # ourselves rather than decode into the void forever
+                os._exit(3)
+        else:
+            self._first_fail = None
 
 
 class _ForwardMetric:
@@ -248,7 +305,9 @@ class ServeReplicaWorker:
                  epoch: float, poll_s: float = 0.002,
                  heartbeat_interval: float = 0.02,
                  fault_plan: Any = None,
-                 forward_spans: bool = False):
+                 forward_spans: bool = False,
+                 generation: int = 0,
+                 orphan_grace_s: Optional[float] = None):
         from ray_lightning_tpu.serve.client import ServeClient
         if fault_plan is not None:
             # the driver's armed FaultPlan crosses the construct pickle
@@ -257,8 +316,13 @@ class ServeReplicaWorker:
             # here is per-process — it cannot leak into other workers
             from ray_lightning_tpu.reliability import faults
             faults.ensure_armed(fault_plan)
-        self._out = out_queue
-        self._hb_channel = heartbeat_channel
+        # every channel put is generation-stamped and timeout-bounded:
+        # a restarted driver refuses this worker's messages by gen, and
+        # a dead manager cannot wedge the dispatch loop inside a put
+        self._out = _FencedChannel(out_queue, generation,
+                                   grace_s=orphan_grace_s)
+        self._hb_channel = _FencedChannel(heartbeat_channel, generation,
+                                          grace_s=orphan_grace_s)
         self._poll_s = float(poll_s)
         self._hb_interval = float(heartbeat_interval)
         self._lock = threading.Lock()
@@ -275,6 +339,10 @@ class ServeReplicaWorker:
         self.client = ServeClient(model, params, clock=time.time,
                                   clock_epoch=epoch, telemetry=self._tel,
                                   **engine_kwargs)
+        # worker ticks are serve.replica territory — only the DRIVER's
+        # tick boundary fires serve.driver (a worker-side fire would be
+        # misread by the fleet as a replica crash)
+        self.client._fire_driver_site = False
         self._beat: Optional[HeartbeatEmitter] = None
         self._thread: Optional[threading.Thread] = None
         self._stop_flag = False

@@ -41,6 +41,18 @@ Sites are woven into the hot paths as a single ``fire(site)`` call:
                       wedges it (deadline pressure on every in-flight
                       row). Only fires on engines armed with a
                       ``draft_model``.
+``serve.driver``      per driver tick: top of ``ServeClient.tick()``
+                      (standalone clients only) and of
+                      ``ReplicaFleet.tick()`` /
+                      ``ProcessReplicaFleet.tick()`` — ``raise``
+                      crashes the DRIVER itself (the propagating
+                      exception is the deterministic mid-decode driver
+                      kill the warm-restart tests and the
+                      ``driver_restart`` chaos bench replay from a
+                      journal), ``stall`` wedges one driver tick.
+                      Fleet-member clients and spawned serve workers
+                      never fire it: their ticks are replica turns,
+                      already covered by ``serve.replica``.
 ``serve.poison``      id-triggered, not tick-scheduled: the engine calls
                       ``poison_check(requests)`` after seating a prefill
                       batch and before every decode dispatch; the plan's
@@ -96,6 +108,7 @@ SITE_RENDEZVOUS_INIT = "rendezvous.init"
 SITE_SERVE_REPLICA = "serve.replica"
 SITE_SERVE_VERIFY = "serve.verify"
 SITE_SERVE_POISON = "serve.poison"
+SITE_SERVE_DRIVER = "serve.driver"
 
 MODE_RAISE = "raise"
 MODE_NAN = "nan"
@@ -118,6 +131,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     SITE_SERVE_REPLICA: (MODE_RAISE, MODE_STALL),
     SITE_SERVE_VERIFY: (MODE_RAISE, MODE_STALL),
     SITE_SERVE_POISON: (MODE_RAISE, MODE_EXIT),
+    SITE_SERVE_DRIVER: (MODE_RAISE, MODE_STALL),
 }
 
 

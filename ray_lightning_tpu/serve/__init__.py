@@ -20,6 +20,8 @@ from ray_lightning_tpu.serve.engine import (KVSlotPool, PendingDispatch,
 from ray_lightning_tpu.serve.fleet import (FleetConfig, FleetDegraded,
                                            FleetSaturated, ReplicaFleet,
                                            Router, RouterConfig)
+from ray_lightning_tpu.serve.journal import (Journal, JournalCorrupt,
+                                             JournalState, read_journal)
 from ray_lightning_tpu.serve.pages import PagePool, PrefixCache
 from ray_lightning_tpu.serve.process_fleet import ProcessReplicaFleet
 from ray_lightning_tpu.serve.request import (Completion, DEFAULT_TENANT,
@@ -43,6 +45,7 @@ __all__ = [
     "FleetDegraded", "SeatTable",
     "TenantClass", "TenantScheduler", "ClassQueueFull", "DEFAULT_TENANT",
     "AdapterRegistry", "AdapterBankFull", "UnknownAdapter",
+    "Journal", "JournalCorrupt", "JournalState", "read_journal",
     "FINISH_EOS", "FINISH_FAILED", "FINISH_LENGTH", "FINISH_REJECTED",
     "FINISH_TIMEOUT",
 ]

@@ -69,6 +69,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_lightning_tpu.reliability import faults, log_suppressed
 from ray_lightning_tpu.reliability.faults import (InjectedFault, MODE_STALL,
+                                                  SITE_SERVE_DRIVER,
                                                   SITE_SERVE_REPLICA)
 # NOTE: reliability.gang / reliability.supervisor are imported lazily
 # inside ReplicaFleet — importing them here closes a cycle (supervisor →
@@ -561,6 +562,7 @@ class ReplicaFleet:
                  router_config: Optional[RouterConfig] = None,
                  telemetry: Any = None,
                  clock: Optional[Callable[[], float]] = None,
+                 journal=None,
                  **engine_kwargs: Any):
         self.backend = "inproc"
         if num_replicas < 1:
@@ -580,6 +582,12 @@ class ReplicaFleet:
         self._next_id = 0
         self._next_replica_id = 0
         self.completions: Dict[int, Completion] = {}
+        # write-ahead request journal (serve/journal.py): the FLEET owns
+        # it — member clients are built with journal=None, so one record
+        # stream covers every replica and failover re-admissions are
+        # re-journaled with their replay binding. journal=None (the
+        # default) is the repo-wide zero-cost contract.
+        self._journal = journal
 
         rcfg = router_config or RouterConfig()
         affinity = rcfg.affinity_tokens
@@ -675,9 +683,14 @@ class ReplicaFleet:
     def _build_client(self) -> ServeClient:
         # clock_epoch=0.0 pins every replica — including ones built
         # mid-run for promotion/scale-out — to the fleet's own t=0
-        return ServeClient(self._model, self._params, clock=self.now,
-                           clock_epoch=0.0, telemetry=self._tel,
-                           **self._engine_kwargs)
+        client = ServeClient(self._model, self._params, clock=self.now,
+                             clock_epoch=0.0, telemetry=self._tel,
+                             **self._engine_kwargs)
+        # a member client's tick is a replica turn (serve.replica
+        # territory) — it must never fire the serve.driver site, whose
+        # raise mode means "the DRIVER died", not "this replica died"
+        client._fire_driver_site = False
+        return client
 
     def _new_replica(self) -> _Replica:
         rep = _Replica(self._next_replica_id, self._build_client())
@@ -752,6 +765,8 @@ class ReplicaFleet:
                 rep, req, load=load,
                 affine=(affine_target is not None
                         and rep.id == affine_target))
+            if self._journal is not None:
+                self._journal.admit(req)
             return rep
         now = self.now()
         total = sum(len(r.client.scheduler) for r in self._replicas)
@@ -787,6 +802,53 @@ class ReplicaFleet:
             replicas=len(ranked),
             class_depths=class_depths or None,
             class_oldest=class_oldest or None)
+
+    # ----------------------------------------------------- warm restart
+    @classmethod
+    def restore(cls, journal_path: str, model, params, *,
+                journal_sync_every: int = 8,
+                **build_kwargs: Any) -> "ReplicaFleet":
+        """Rebuild a fleet from a dead driver's journal and re-admit
+        every unretired request through the router's replay lane.
+
+        ``build_kwargs`` are the same constructor arguments the dead
+        fleet was built with (``backend="process"`` included — the
+        ``__new__`` dispatch applies here too, so a process fleet
+        restores as a process fleet). The journal is REOPENED with a
+        bumped generation: on the process backend that generation is
+        stamped into every fresh worker, and the driver's queue drains
+        refuse messages still carrying the dead driver's generation
+        (the split-brain fence), while the dead driver's orphaned
+        workers self-reap within the grace window. Re-admissions ride
+        :meth:`_readmit` — fit-checked against the replay window,
+        parked when every replica is transiently full, failover
+        budget/probation honored — with ``replay_tokens`` set from the
+        journaled frontier, so token identity holds by the PR 3 replay
+        argument and retired requests are never re-emitted.
+        """
+        from ray_lightning_tpu.serve.journal import (
+            COUNTER_JOURNAL_REPLAYED, EVENT_JOURNAL_RESTORED, Journal,
+            read_journal)
+        state = read_journal(journal_path)
+        journal = Journal(journal_path, sync_every=journal_sync_every,
+                          generation=state.generation + 1,
+                          telemetry=build_kwargs.get("telemetry"))
+        fleet = cls(model, params, journal=journal, **build_kwargs)
+        pending = state.pending()
+        for req, toks in pending:
+            fleet._readmit(req, list(toks) if toks else None)
+        fleet._next_id = max(fleet._next_id, state.next_request_id)
+        tel = fleet._tel
+        if tel is not None:
+            tel.event(EVENT_JOURNAL_RESTORED, path=str(journal_path),
+                      generation=journal.generation,
+                      replayed=len(pending), retired=len(state.retired),
+                      torn_tail=state.torn_tail)
+            tel.metrics.counter(
+                COUNTER_JOURNAL_REPLAYED,
+                help="unretired requests re-admitted by warm restart"
+            ).inc(len(pending))
+        return fleet
 
     # ---------------------------------------------------- hot adapters
     def load_adapter(self, name: str, adapter) -> Optional[str]:
@@ -860,6 +922,10 @@ class ReplicaFleet:
         applies its silence verdicts and the autoscaler runs. Returns
         the completions this round retired (failover casualties
         included)."""
+        # the driver-death site: raise mode propagates out of the
+        # fleet's own tick — the whole fleet state machine dies, which
+        # is exactly what ReplicaFleet.restore exists to survive
+        faults.fire(SITE_SERVE_DRIVER)
         done: List[Completion] = []
         # parked failover re-admissions (every survivor transiently
         # full at failover time) retry BEFORE the dispatch turns, so a
@@ -929,6 +995,15 @@ class ReplicaFleet:
                 GAUGE_QUEUE_DEPTH,
                 help="requests waiting across every replica's queue"
             ).set(sum(len(r.client.scheduler) for r in self._replicas))
+        journal = self._journal
+        if journal is not None:
+            # journal every replica's synced frontier (the same
+            # snapshot failover replays from) so a driver death loses
+            # at most the records inside the fsync window
+            for rep in self._replicas:
+                for req, toks in rep.client.engine.snapshot_in_flight():
+                    journal.note_frontier(req.id, toks,
+                                          req.first_token_time)
         return done
 
     def _runnable(self, rep: _Replica) -> bool:
@@ -978,6 +1053,8 @@ class ReplicaFleet:
 
     def _note_completion(self, rep: _Replica, comp: Completion) -> None:
         self.completions[comp.request_id] = comp
+        if self._journal is not None:
+            self._journal.retire(comp)
         ttft = comp.time_to_first_token
         if ttft is not None:
             self.router.record_ttft(rep.id, ttft)
@@ -1045,6 +1122,8 @@ class ReplicaFleet:
             if rid not in self.completions]
         for comp in done:
             self.completions[comp.request_id] = comp
+            if self._journal is not None:
+                self._journal.retire(comp)
         promoted_early = False
         if not self._replicas:
             # sole-replica fleet: with no survivor to replay onto,
@@ -1144,6 +1223,8 @@ class ReplicaFleet:
         comp = failed_completion(req, req.replay_tokens or ())
         comp.finish_time = self.now()
         self.completions[comp.request_id] = comp
+        if self._journal is not None:
+            self._journal.retire(comp)
         return comp
 
     def _retire_poison(self, req: Request) -> List[Completion]:
@@ -1188,6 +1269,8 @@ class ReplicaFleet:
                     prefix_hit_tokens=req.prefix_hit_tokens,
                     tenant=req.tenant, adapter=req.adapter)
                 self.completions[comp.request_id] = comp
+                if self._journal is not None:
+                    self._journal.retire(comp)
                 done.append(comp)
                 continue
             survivors = self._replicas
@@ -1261,6 +1344,10 @@ class ReplicaFleet:
         except QueueFull:
             return  # idle replica refused (quota edge); retry next tick
         self._probation.pop(0)
+        if self._journal is not None:
+            # the probation seat is an admission too — a driver death
+            # mid-probation must still replay the suspect
+            self._journal.admit(req)
         self._probation_obj = req
         if self._tel is not None:
             self._tel.event(EVENT_PROBATION, id=req.id, phase="seated",
@@ -1550,3 +1637,7 @@ class ReplicaFleet:
             self.standby.shutdown()
         self.router.shutdown()
         self._monitor = None
+        journal = self._journal
+        if journal is not None:
+            self._journal = None
+            journal.shutdown()

@@ -62,7 +62,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ray_lightning_tpu.reliability import faults, log_suppressed
+from ray_lightning_tpu.reliability.faults import SITE_SERVE_DRIVER
 from ray_lightning_tpu.serve.containment import SeatTable
+from ray_lightning_tpu.serve.journal import (COUNTER_JOURNAL_STALE,
+                                             EVENT_JOURNAL_STALE)
 from ray_lightning_tpu.serve.fleet import (COUNTER_FAILOVERS,
                                            COUNTER_POISON_FAILED,
                                            COUNTER_READMITTED, COUNTER_SHED,
@@ -258,8 +261,13 @@ class ProcessReplicaFleet(ReplicaFleet):
     env — how a TPU host gives each replica its own chip slice),
     ``submit_timeout`` (seconds one admission RPC may take),
     ``scale_eval_interval`` (autoscaler evaluation cadence, wall
-    seconds). ``clock=`` is rejected: the process backend is wall-clock
-    by construction (trace times and deadlines are in seconds).
+    seconds), ``orphan_grace_s`` (arm driver-death orphan reaping:
+    workers that lose the driver self-terminate within this window, and
+    every worker-side queue op is timeout-bounded by it — set it
+    whenever a :class:`~ray_lightning_tpu.serve.journal.Journal` is
+    armed for warm restart). ``clock=`` is rejected: the process
+    backend is wall-clock by construction (trace times and deadlines
+    are in seconds).
     """
 
     def __init__(self, model, params, *, backend: str = "process",
@@ -273,6 +281,8 @@ class ProcessReplicaFleet(ReplicaFleet):
                  = None,
                  submit_timeout: float = 60.0,
                  scale_eval_interval: float = 0.05,
+                 journal: Any = None,
+                 orphan_grace_s: Optional[float] = None,
                  **engine_kwargs: Any):
         if num_replicas < 1:
             raise ValueError(
@@ -308,9 +318,22 @@ class ProcessReplicaFleet(ReplicaFleet):
         #: request id -> _Tracked for everything admitted somewhere and
         #: not yet retired — the failover ledger AND the busy probe
         self._inflight: Dict[int, _Tracked] = {}
+        # driver-death survival (docs/reliability.md): the WAL records
+        # admissions/frontiers/retirements; its generation is the
+        # split-brain fence — stamped into every spawned worker's
+        # messages and beats, and checked in both queue drains, so a
+        # warm-restarted driver (generation+1) refuses anything raced
+        # over from the dead driver's workers. journal=None keeps the
+        # repo-wide zero-cost contract.
+        self._journal = journal
+        self._generation = (journal.generation
+                            if journal is not None else 0)
+        self._orphan_grace_s = (float(orphan_grace_s)
+                                if orphan_grace_s is not None else None)
+        self.stale_dropped = 0
 
         from ray_lightning_tpu.launchers.process_backend import ProcessRay
-        self._ray = ProcessRay()
+        self._ray = ProcessRay(orphan_grace_s=self._orphan_grace_s)
         self._ray.init()
         self._out = self._ray.make_queue()
         self._hb = self._ray.make_queue()
@@ -431,6 +454,13 @@ class ProcessReplicaFleet(ReplicaFleet):
         env.update(self._worker_env)
         if self._per_seat_env is not None:
             env.update(self._per_seat_env(seat))
+        if self._orphan_grace_s is not None:
+            # arms the worker's ppid watchdog (process_backend): a
+            # SIGKILLed driver's workers self-reap within the grace
+            # window instead of decoding into the void forever
+            from ray_lightning_tpu.launchers.process_backend import \
+                ORPHAN_GRACE_ENV
+            env[ORPHAN_GRACE_ENV] = repr(self._orphan_grace_s)
         hb_interval = min(0.25, max(0.005,
                                     self._cfg.heartbeat_timeout / 8.0))
         # construct crosses a fresh interpreter (jax import + engine
@@ -446,7 +476,11 @@ class ProcessReplicaFleet(ReplicaFleet):
             fault_plan=faults.get_armed(),
             # real worker-side spans (MSG_SPAN) only when the driver is
             # armed: a disarmed fleet's workers keep the no-op span
-            forward_spans=self._tel is not None)
+            forward_spans=self._tel is not None,
+            # the split-brain fence stamp: every message/beat this
+            # worker puts carries the spawning driver's generation
+            generation=self._generation,
+            orphan_grace_s=self._orphan_grace_s)
 
     def _activate(self, handle: Any) -> _ProcessReplica:
         rid = self._next_replica_id
@@ -542,6 +576,8 @@ class ProcessReplicaFleet(ReplicaFleet):
                     "failing it over and continuing down the order")
                 for comp in self._fail_replica(rep):
                     self.completions[comp.request_id] = comp
+                    if self._journal is not None:
+                        self._journal.retire(comp)
                 continue
             if not verdict["ok"]:
                 continue  # QueueFull/ClassQueueFull: shed to the next
@@ -551,6 +587,13 @@ class ProcessReplicaFleet(ReplicaFleet):
                 affine=(affine_target is not None
                         and rep.id == affine_target))
             self._inflight[req.id] = _Tracked(req, rep.id)
+            if self._journal is not None:
+                # journaled AFTER the seat is won (a fleet-wide refusal
+                # never journals — rejected requests are not admissions)
+                # and with replay_tokens as-fed: a failover re-admission
+                # re-journals with its binding, resetting the reader's
+                # frontier to the replayed prefix
+                self._journal.admit(req)
             return rep
         now = self.now()
         total = sum(r.client.scheduler.depth for r in self._replicas)
@@ -589,6 +632,9 @@ class ProcessReplicaFleet(ReplicaFleet):
         dispatch continuously regardless; this only moves results and
         supervision forward. Returns completions recorded this round
         (failover casualties included)."""
+        # the driver tick boundary — the serve.driver chaos site (a
+        # raise here IS the driver death the warm-restart tests replay)
+        faults.fire(SITE_SERVE_DRIVER)
         done: List[Completion] = []
         self._pump_parked(done)
         self._drain_messages(done)
@@ -643,6 +689,8 @@ class ProcessReplicaFleet(ReplicaFleet):
             ).set(sum(r.client.scheduler.depth for r in self._replicas))
         for comp in done:
             self.completions[comp.request_id] = comp
+            if self._journal is not None:
+                self._journal.retire(comp)
         return done
 
     # -------------------------------------------------- message pumping
@@ -656,7 +704,14 @@ class ProcessReplicaFleet(ReplicaFleet):
                 item = self._out.get(block=False)
             except (_queue.Empty, EOFError, OSError):
                 return
-            _kind, rid, batch = item
+            if not (isinstance(item, tuple) and len(item) == 4):
+                continue
+            _kind, rid, batch, gen = item
+            if gen != self._generation:
+                # split-brain fence: a batch raced over from a dead
+                # driver's worker (its generation predates our restart)
+                self._note_stale(gen)
+                continue
             rep = by_id.get(rid)
             for msg in batch:
                 mk = msg[0]
@@ -675,6 +730,13 @@ class ProcessReplicaFleet(ReplicaFleet):
                                 # ride the ledger's request object: a
                                 # re-admission must not restamp TTFT
                                 t.req.first_token_time = ft
+                            if self._journal is not None:
+                                # the flushed stream IS this backend's
+                                # synced frontier: exactly what failover
+                                # (and warm restart) would replay
+                                self._journal.note_frontier(
+                                    req_id, t.tokens,
+                                    t.req.first_token_time)
                 elif mk == MSG_STATUS:
                     if rep is not None:
                         rep.apply_stats(msg[2])
@@ -718,9 +780,12 @@ class ProcessReplicaFleet(ReplicaFleet):
                 item = self._hb.get(block=False)
             except (_queue.Empty, EOFError, OSError):
                 return
-            if not (isinstance(item, tuple) and len(item) == 3):
+            if not (isinstance(item, tuple) and len(item) == 4):
                 continue
-            rid, step, _worker_t = item
+            rid, step, _worker_t, gen = item
+            if gen != self._generation:
+                self._note_stale(gen)
+                continue
             i = idx_of.get(rid)
             if i is None:
                 continue  # beat from a replica failed over mid-flight
@@ -729,6 +794,19 @@ class ProcessReplicaFleet(ReplicaFleet):
             rep.last_beat = self.now()
             rep.last_step = max(rep.last_step, int(step))
             rep.beats += 1
+
+    def _note_stale(self, gen: Any) -> None:
+        """One fenced-off message: wrong-generation traffic from a dead
+        driver's worker (or a malformed item). Counted, evented, and
+        dropped — never folded into the ledger or the monitor."""
+        self.stale_dropped += 1
+        if self._tel is not None:
+            self._tel.event(EVENT_JOURNAL_STALE, generation=gen,
+                            expected=self._generation)
+            self._tel.metrics.counter(
+                COUNTER_JOURNAL_STALE,
+                help="wrong-generation worker messages refused by the "
+                     "driver's split-brain fence").inc()
 
     def _note_ttft(self, replica_id: int, comp: Completion) -> None:
         ttft = comp.time_to_first_token
@@ -995,6 +1073,10 @@ class ProcessReplicaFleet(ReplicaFleet):
         rep.apply_stats(verdict["stats"])
         self._probation.pop(0)
         self._inflight[req.id] = _Tracked(req, rep.id)
+        if self._journal is not None:
+            # the probation seat is an admission too — a driver death
+            # mid-probation must still replay the suspect
+            self._journal.admit(req)
         self._probation_obj = req
         if self._tel is not None:
             self._tel.event(EVENT_PROBATION, id=req.id, phase="seated",
@@ -1225,6 +1307,10 @@ class ProcessReplicaFleet(ReplicaFleet):
         self.router.shutdown()
         self._monitor = None
         self._inflight.clear()
+        journal = self._journal
+        if journal is not None:
+            self._journal = None
+            journal.shutdown()
         self._ray.shutdown()
         self._out = None
         self._hb = None

@@ -1,0 +1,702 @@
+"""Driver-death survival: WAL pins, warm-restart identity, real kills.
+
+The load-bearing assertions:
+
+- the journal file format round-trips (CRC per record, torn-tail
+  tolerance, mid-file damage refused, exactly-once retires);
+- a SIMULATED driver restart (abandon the client/fleet without
+  shutdown, ``restore`` from the journal) re-emits every unretired
+  request token-identically to an uninterrupted run — greedy AND
+  sampled, tenancy and adapter bindings preserved, and never re-emits
+  a request whose retire record is durable (zero duplicate
+  completions);
+- a REAL driver kill (SIGKILL the driver process of a
+  ``backend="process"`` fleet) leaves zero orphaned workers — the
+  ppid watchdog self-reaps them within the grace window — and the
+  warm-restarted driver (bumped journal generation, the ``serve.driver``
+  split-brain fence) replays to the same tokens;
+- ``journal=None`` is zero-surface: byte-identical outputs to an armed
+  run, per the repo-wide disarmed-is-free contract.
+
+Driver-death chaos rides the ``serve.driver`` fault site
+(``FaultPlan.at("serve.driver", [k])`` raises at the k-th driver tick
+boundary — the in-process stand-in for the kill -9 the process-backend
+test performs for real).
+"""
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ray_lightning_tpu.reliability import FaultPlan
+from ray_lightning_tpu.reliability.faults import InjectedFault
+from ray_lightning_tpu.serve import (Journal, JournalCorrupt, ReplicaFleet,
+                                     Request, ServeClient, TenantClass,
+                                     read_journal)
+from ray_lightning_tpu.serve.journal import _canonical, _crc
+from ray_lightning_tpu.serve.request import Completion
+
+pytestmark = pytest.mark.serve
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _req(rid, prompt, **kw):
+    kw.setdefault("max_new_tokens", 8)
+    return Request(id=rid, prompt=list(prompt), **kw)
+
+
+def _comp(rid, tokens, reason="eos"):
+    return Completion(request_id=rid, prompt=[1], tokens=list(tokens),
+                      finish_reason=reason)
+
+
+# ---------------------------------------------------------------- WAL unit
+def test_wal_roundtrip(tmp_path):
+    """Admissions, frontier deltas, and retires fold back into exactly
+    the state the writer journaled — bindings included."""
+    path = tmp_path / "wal.jsonl"
+    j = Journal(path, sync_every=1, generation=3)
+    j.admit(_req(0, [5, 17, 3], temperature=0.9, top_k=8, seed=11,
+                 tenant="fast", adapter="a"))
+    j.admit(_req(1, [9, 2], replay_tokens=[7, 7]))  # re-admission shape
+    j.note_frontier(0, [40, 41], first_token_time=0.25)
+    j.note_frontier(0, [40, 41, 42])           # cumulative → delta [42]
+    j.note_frontier(0, [40, 41, 42])           # no delta → no record
+    j.note_frontier(1, [7, 7, 90])             # extends past the replay
+    j.note_frontier(99, [1, 2, 3])             # unknown id → ignored
+    j.retire(_comp(0, [40, 41, 42]))
+    records = j.records
+    j.shutdown()
+    assert j.closed and Journal.close is Journal.shutdown
+
+    st = read_journal(path)
+    assert st.generation == 3 and not st.torn_tail
+    assert st.records == records and st.duplicate_retires == 0
+    assert sorted(st.admitted) == [0, 1]
+    assert st.admitted[0].tenant == "fast"
+    assert st.admitted[0].adapter == "a"
+    assert st.admitted[0].temperature == 0.9
+    assert st.admitted[0].seed == 11
+    assert st.admitted[0].first_token_time == 0.25
+    assert st.frontier[0] == [40, 41, 42]
+    assert st.frontier[1] == [7, 7, 90]
+    assert st.retired == {0: "eos"}
+    assert [(r.id, t) for r, t in st.pending()] == [(1, [7, 7, 90])]
+    assert st.next_request_id == 2
+
+
+def test_wal_retire_exactly_once(tmp_path):
+    """Duplicate retires of one id write ONE record — the exactly-once
+    commit rule — and a retired id's frontier stops journaling."""
+    path = tmp_path / "wal.jsonl"
+    j = Journal(path, sync_every=1)
+    j.admit(_req(0, [1, 2]))
+    before = j.records
+    j.retire(_comp(0, [9], reason="length"))
+    j.retire(_comp(0, [9], reason="length"))
+    j.retire(_comp(0, [9], reason="timeout"))
+    assert j.records == before + 1
+    j.note_frontier(0, [9, 10])  # retired: ignored
+    assert j.records == before + 1
+    j.shutdown()
+    st = read_journal(path)
+    assert st.retired == {0: "length"} and st.duplicate_retires == 0
+    assert st.pending() == []
+
+
+def test_wal_torn_tail_dropped(tmp_path):
+    """A half-written final record — what an interrupted append leaves
+    — is dropped and flagged; everything before it survives."""
+    path = tmp_path / "wal.jsonl"
+    j = Journal(path, sync_every=1)
+    j.admit(_req(0, [1, 2]))
+    j.admit(_req(1, [3]))
+    j.shutdown()
+    raw = path.read_bytes()
+    path.write_bytes(raw[:-9])  # tear the last admit mid-record
+    st = read_journal(path)
+    assert st.torn_tail
+    assert sorted(st.admitted) == [0]  # the torn admit is gone
+
+
+def test_wal_midfile_damage_refused(tmp_path):
+    """A bad CRC BEFORE the final record is damage, not a torn tail."""
+    path = tmp_path / "wal.jsonl"
+    j = Journal(path, sync_every=1)
+    j.admit(_req(0, [1, 2]))
+    j.admit(_req(1, [3]))
+    j.shutdown()
+    lines = path.read_text().splitlines(keepends=True)
+    assert len(lines) == 3
+    lines[1] = lines[1].replace('"prompt":[1,2]', '"prompt":[1,9]')
+    path.write_text("".join(lines))
+    with pytest.raises(JournalCorrupt, match="unreadable record"):
+        read_journal(path)
+
+
+def _raw_line(doc):
+    payload = _canonical(doc)
+    return f"{_crc(payload):08x} {payload}\n"
+
+
+def test_wal_frontier_gap_and_newer_schema_refused(tmp_path):
+    """A frontier record that does not extend its stream contiguously,
+    or an ``open`` record from a newer schema, is corruption — the
+    reader refuses rather than replaying a wrong stream."""
+    gap = tmp_path / "gap.jsonl"
+    gap.write_text(
+        _raw_line({"t": "open", "v": 1, "gen": 0})
+        + _raw_line({"t": "admit",
+                     "req": {"id": 0, "prompt": [1], "max_new_tokens": 4}})
+        + _raw_line({"t": "front", "id": 0, "k": 5, "d": [7]})
+        + _raw_line({"t": "retire", "id": 0, "reason": "eos", "n": 1}))
+    with pytest.raises(JournalCorrupt, match="frontier gap"):
+        read_journal(gap)
+
+    newer = tmp_path / "newer.jsonl"
+    newer.write_text(_raw_line({"t": "open", "v": 99, "gen": 0}))
+    with pytest.raises(JournalCorrupt, match="newer"):
+        read_journal(newer)
+
+    # unknown record kinds from a future MINOR writer are skipped
+    fwd = tmp_path / "fwd.jsonl"
+    fwd.write_text(
+        _raw_line({"t": "open", "v": 1, "gen": 2})
+        + _raw_line({"t": "hint", "x": 1})
+        + _raw_line({"t": "admit",
+                     "req": {"id": 0, "prompt": [1], "max_new_tokens": 4}}))
+    st = read_journal(fwd)
+    assert st.generation == 2 and sorted(st.admitted) == [0]
+
+
+def test_wal_batched_fsync(tmp_path):
+    """``sync_every`` batches durability: the open record syncs
+    immediately, then one fsync per ``sync_every`` appends."""
+    path = tmp_path / "wal.jsonl"
+    j = Journal(path, sync_every=4)
+    assert j.syncs == 1  # the open record (generation fence) is durable
+    for i in range(8):
+        j.admit(_req(i, [1]))
+    assert j.syncs == 3
+    j.shutdown()
+    assert j.syncs == 3  # clean: shutdown's sync was a no-op
+    assert len(read_journal(path).admitted) == 8
+
+
+# ----------------------------------------------------- simulated restarts
+@pytest.fixture(scope="module")
+def nano(serve_nano_family):
+    return serve_nano_family[:2]
+
+
+CLASSES = [TenantClass("fast", weight=4.0, tier="interactive"),
+           TenantClass("bulk", weight=1.0, tier="batch")]
+
+#: greedy + sampled + tenancy-bound rows; seeds pin the key streams.
+#: The short row rides FIRST so its retire record is durable before the
+#: simulated kill — the exactly-once (never re-emit) pin needs one.
+WORK = [
+    (dict(prompt=[1, 2], max_new_tokens=2, seed=103, tenant="bulk")),
+    (dict(prompt=[5, 17, 3, 9], max_new_tokens=6, seed=100,
+          tenant="fast")),
+    (dict(prompt=[9, 2, 44], max_new_tokens=6, temperature=0.9, top_k=8,
+          seed=101, tenant="bulk")),
+    (dict(prompt=[42, 7], max_new_tokens=6, temperature=0.7, seed=102,
+          tenant="fast")),
+]
+
+CKW = dict(num_slots=3, prefill_len=16, tenant_classes=CLASSES)
+
+
+def _run_client(dec, params, journal=None, ticks=None, **kw):
+    client = ServeClient(dec, params, journal=journal, **CKW, **kw)
+    for w in WORK:
+        client.submit(**w)
+    if ticks is None:
+        out = client.run_until_idle()
+        client.shutdown()
+        return out
+    for _ in range(ticks):
+        client.tick()
+    return client  # abandoned mid-flight: the caller simulates death
+
+
+def test_client_restart_token_identity(nano, tmp_path):
+    """Kill the driver mid-decode (simulated: abandon without
+    shutdown), ``ServeClient.restore`` from the journal, and every
+    unretired request — greedy and sampled — finishes token-identical
+    to the uninterrupted run, tenant class preserved; the request whose
+    retire record is durable is NEVER re-emitted."""
+    ref = _run_client(*nano)
+    dec, params = nano
+    path = tmp_path / "wal.jsonl"
+    dead = _run_client(dec, params, journal=Journal(path, sync_every=1),
+                       ticks=5)
+    retired_early = set(dead.completions)
+    assert retired_early, "workload must retire something pre-kill " \
+        "(the short max_new_tokens row) for the exactly-once pin"
+    del dead  # driver death: no shutdown, no final sync beyond per-record
+
+    st = read_journal(path)
+    assert set(st.retired) == retired_early
+    pend = {r.id for r, _ in st.pending()}
+    assert pend == set(ref) - retired_early and pend
+    assert all(toks for _, toks in st.pending()), \
+        "kill must land mid-decode (journaled frontiers non-empty)"
+
+    restored = ServeClient.restore(path, dec, params, **CKW)
+    out = restored.run_until_idle()
+    restored.shutdown()
+    # zero duplicate completions: exactly the unretired set re-emits
+    assert set(out) == pend
+    for rid in pend:
+        assert out[rid].tokens == ref[rid].tokens, \
+            (rid, ref[rid].tokens, out[rid].tokens)
+        assert out[rid].tenant == ref[rid].tenant
+        assert out[rid].finish_reason == ref[rid].finish_reason
+    # restored ids continue after the dead driver's id space
+    assert restored._next_id >= st.next_request_id
+
+
+def test_client_restart_preserves_adapter_binding(nano, tmp_path):
+    """Warm restart re-binds journaled adapters: an adapter-bound
+    sampled stream crosses the restart token-identically (the binding
+    rides the admit record; the restored engine holds the same
+    resident bank)."""
+    from ray_lightning_tpu.models.lora import (LoraConfig, extract_adapter,
+                                               install_lora_bank)
+    import jax
+
+    dec, params = nano
+
+    def rand_adapter(seed):
+        tree = extract_adapter(
+            install_lora_bank(params, LoraConfig(rank=2, num_adapters=1)),
+            0)
+
+        def rnd(t, key):
+            out = {}
+            for k, v in sorted(t.items()):
+                key, sub = jax.random.split(key)
+                out[k] = (rnd(v, sub) if isinstance(v, dict)
+                          else 0.3 * jax.random.normal(sub, v.shape,
+                                                       v.dtype))
+            return out
+        return rnd(tree, jax.random.PRNGKey(seed))
+
+    ads = {"a": rand_adapter(1), "b": rand_adapter(2)}
+    akw = dict(num_slots=2, prefill_len=16, adapters=ads,
+               max_resident_adapters=2, lora_rank=2)
+    work = [dict(prompt=[1, 2, 3], max_new_tokens=6, adapter="a",
+                 temperature=0.9, seed=100),
+            dict(prompt=[2, 2, 3], max_new_tokens=6, adapter="b",
+                 seed=101)]
+
+    def run(journal=None, ticks=None):
+        client = ServeClient(dec, params, journal=journal, **akw)
+        for w in work:
+            client.submit(**w)
+        if ticks is None:
+            out = client.run_until_idle()
+            client.shutdown()
+            return out
+        for _ in range(ticks):
+            client.tick()
+        return client
+
+    ref = run()
+    path = tmp_path / "wal.jsonl"
+    dead = run(journal=Journal(path, sync_every=1), ticks=3)
+    del dead
+    st = read_journal(path)
+    assert {r.adapter for r, _ in st.pending()} == {"a", "b"}
+    restored = ServeClient.restore(path, dec, params, **akw)
+    out = restored.run_until_idle()
+    restored.shutdown()
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens, rid
+        assert out[rid].adapter == ref[rid].adapter
+
+
+def test_journal_disarmed_zero_surface(nano, tmp_path):
+    """``journal=None`` (the default) changes nothing: byte-identical
+    completions to an armed run, and the armed run's journal overhead
+    is pure appends (no behavioral coupling)."""
+    ref = _run_client(*nano)
+    dec, params = nano
+    j = Journal(tmp_path / "wal.jsonl", sync_every=64)
+    client = ServeClient(dec, params, journal=j, **CKW)
+    assert ServeClient(dec, params, **CKW)._journal is None
+    for w in WORK:
+        client.submit(**w)
+    out = client.run_until_idle()
+    client.shutdown()
+    assert j.closed  # the owning client closed it
+    for rid in ref:
+        assert out[rid].tokens == ref[rid].tokens
+    st = read_journal(j.path)
+    assert set(st.retired) == set(ref) and not st.pending()
+
+
+FKW = dict(num_replicas=2, num_slots=2, prefill_len=16)
+
+
+def test_fleet_restart_token_identity(nano, tmp_path):
+    """Same pin at fleet scope: ``ReplicaFleet.restore`` re-admits the
+    dead driver's unretired requests through the router replay seat."""
+    dec, params = nano
+
+    def run(journal=None, ticks=None):
+        fleet = ReplicaFleet(dec, params, journal=journal, **FKW)
+        for w in WORK:
+            fleet.submit(**{k: v for k, v in w.items() if k != "tenant"})
+        if ticks is None:
+            out = fleet.run_until_idle()
+            fleet.shutdown()
+            return out
+        for _ in range(ticks):
+            fleet.tick()
+        return fleet
+
+    ref = run()
+    path = tmp_path / "wal.jsonl"
+    dead = run(journal=Journal(path, sync_every=1), ticks=4)
+    retired_early = set(dead.completions)
+    assert retired_early  # the short row's retire record is durable
+    del dead
+
+    st = read_journal(path)
+    pend = {r.id for r, _ in st.pending()}
+    assert pend and pend == set(ref) - retired_early
+    fleet = ReplicaFleet.restore(path, dec, params, **FKW)
+    out = fleet.run_until_idle()
+    fleet.shutdown()
+    assert set(out) == pend  # zero duplicate completions
+    for rid in pend:
+        assert out[rid].tokens == ref[rid].tokens, rid
+
+
+def test_driver_fault_site_chaos_then_restore(nano, tmp_path):
+    """The ``serve.driver`` site IS the driver death: a raise at a tick
+    boundary unwinds ``run_until_idle`` exactly like a crash, and the
+    journal restores across it. Fleet-member clients never fire the
+    site (their ticks are ``serve.replica`` territory — a member raise
+    would be misread as a replica crash)."""
+    dec, params = nano
+    ref = _run_client(*nano)
+    path = tmp_path / "wal.jsonl"
+    client = ServeClient(dec, params,
+                         journal=Journal(path, sync_every=1), **CKW)
+    for w in WORK:
+        client.submit(**w)
+    plan = FaultPlan.at("serve.driver", [4])
+    with plan.armed():
+        with pytest.raises(InjectedFault):
+            client.run_until_idle()
+    assert plan.fired == 1
+    del client  # dead driver: no shutdown
+
+    restored = ServeClient.restore(path, dec, params, **CKW)
+    out = restored.run_until_idle()
+    restored.shutdown()
+    st = read_journal(path)
+    for rid in ref:
+        got = out[rid] if rid in out else None
+        if got is None:
+            assert rid in st.retired  # retired pre-crash, not re-emitted
+        else:
+            assert got.tokens == ref[rid].tokens, rid
+
+
+def test_fleet_member_clients_never_fire_driver_site(nano):
+    """An armed serve.driver plan with a huge tick index: the fleet's
+    own tick counter advances it, member replicas don't — so the count
+    after a run equals the fleet's tick count, not ticks × replicas."""
+    dec, params = nano
+    fleet = ReplicaFleet(dec, params, **FKW)
+    assert all(rep.client._fire_driver_site is False
+               for rep in fleet._replicas)
+    plan = FaultPlan.at("serve.driver", [10 ** 9])
+    with plan.armed():
+        for _ in range(3):
+            fleet.tick()
+        assert plan._counts["serve.driver"] == 3
+    fleet.shutdown()
+
+
+# ------------------------------------------------------- real driver kill
+_DRIVER_SCRIPT = """
+import json, os, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.serve import Journal, ReplicaFleet
+
+wal = sys.argv[1]
+mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
+          scan_layers=False)
+dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+params = TransformerLM(gpt2_config("nano", **mk)).init(
+    jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+fleet = ReplicaFleet(dec, params, backend="process", num_replicas=1,
+                     journal=Journal(wal, sync_every=1),
+                     orphan_grace_s=1.0, num_slots=3, prefill_len=32)
+for w in json.loads(sys.argv[2]):
+    fleet.submit(**w)
+# pump until every request has >= 2 journaled frontier tokens, then
+# STOP ticking (so they stay unretired) and wait to be killed — the
+# long max_new_tokens keeps the kill point safely mid-decode
+deadline = time.time() + 240
+while time.time() < deadline:
+    fleet.tick()
+    sent = fleet._journal._sent
+    if fleet._journal._retired:
+        raise SystemExit("request retired before the kill point")
+    if sent and all(v >= 2 for v in sent.values()):
+        break
+    time.sleep(0.01)
+else:
+    raise SystemExit("no frontier progress before deadline")
+pids = [rep.actor._proc.pid for rep in fleet._replicas]
+pids.append(fleet.process_backend._manager._process.pid)
+print("PIDS " + json.dumps(pids), flush=True)
+print("READY", flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def test_process_driver_sigkill_warm_restart(nano, tmp_path):
+    """The real thing: SIGKILL the driver of a ``backend="process"``
+    fleet mid-decode. The orphaned worker AND the queue manager
+    self-reap within the grace window (zero leaked processes), and a
+    warm restart in a fresh driver — bumped generation — replays every
+    unretired request token-identically."""
+    dec, params = nano
+    work = [dict(prompt=[5, 17, 3, 9], max_new_tokens=24, seed=100),
+            dict(prompt=[9, 2, 44], max_new_tokens=24, temperature=0.9,
+                 top_k=8, seed=101)]
+    # uninterrupted reference on an identical single engine
+    ref_client = ServeClient(dec, params, num_slots=3, prefill_len=32)
+    for w in work:
+        ref_client.submit(**w)
+    ref = ref_client.run_until_idle()
+    ref_client.shutdown()
+
+    wal = tmp_path / "wal.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _DRIVER_SCRIPT, str(wal),
+         json.dumps(work)],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+    pids = []
+    try:
+        deadline = time.time() + 300
+        for line in proc.stdout:
+            if line.startswith("PIDS "):
+                pids = json.loads(line[5:])
+            if line.strip() == "READY":
+                break
+            if time.time() > deadline:
+                break
+        assert pids, "driver never reported its worker pids"
+        os.kill(proc.pid, signal.SIGKILL)  # the driver death
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # orphan reaping: worker + manager exit within grace (1 s) + margin
+    deadline = time.time() + 30
+    while time.time() < deadline and any(_pid_alive(p) for p in pids):
+        time.sleep(0.2)
+    leaked = [p for p in pids if _pid_alive(p)]
+    for p in leaked:  # never leak into the suite even on failure
+        os.kill(p, signal.SIGKILL)
+    assert not leaked, f"orphaned processes survived the grace: {leaked}"
+
+    st = read_journal(wal)
+    assert st.generation == 0 and not st.retired
+    pend = {r.id for r, _ in st.pending()}
+    assert pend == set(ref)
+    assert all(len(t) >= 2 for _, t in st.pending())
+
+    fleet = ReplicaFleet.restore(wal, dec, params, backend="process",
+                                 num_replicas=1, orphan_grace_s=1.0,
+                                 num_slots=3, prefill_len=32)
+    try:
+        assert fleet._generation == 1  # the split-brain fence bumped
+        out = fleet.run_until_idle()
+    finally:
+        fleet.shutdown()
+    assert fleet.process_backend.live_actor_count() == 0
+    assert set(out) == pend  # zero duplicate completions
+    for rid in pend:
+        assert out[rid].tokens == ref[rid].tokens, \
+            (rid, ref[rid].tokens, out[rid].tokens)
+    # the restarted journal holds the whole story: generation 1 open
+    # record, re-admissions with replay bindings, final retires
+    st2 = read_journal(wal)
+    assert st2.generation == 1
+    assert set(st2.retired) == pend and not st2.pending()
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def test_stale_generation_messages_refused(nano, tmp_path):
+    """Split-brain fence unit pin: wrong-generation batches and beats
+    on the manager queues are counted + dropped, never folded into the
+    ledger or the gang monitor."""
+    from ray_lightning_tpu.launchers.serve_worker import (MSG_BATCH,
+                                                          MSG_STATUS)
+    from ray_lightning_tpu.obs import Telemetry
+    dec, params = nano
+    tel = Telemetry()
+    fleet = ReplicaFleet(dec, params, backend="process", num_replicas=1,
+                         journal=Journal(tmp_path / "wal.jsonl",
+                                         generation=2, sync_every=1),
+                         telemetry=tel, num_slots=2, prefill_len=8)
+    try:
+        assert fleet._generation == 2
+        rid = fleet._replicas[0].id
+        # a dead driver's worker raced these over: generation 1 < 2
+        fleet._out.put((MSG_BATCH, rid,
+                        [(MSG_STATUS, rid, {"queue_depth": 77})], 1))
+        fleet._hb.put((rid, 999, 0.0, 1))
+        deadline = time.time() + 10
+        while fleet.stale_dropped < 2 and time.time() < deadline:
+            fleet.tick()
+        assert fleet.stale_dropped == 2
+        # the stale status never reached the mirror, the stale beat
+        # never advanced the monitor
+        assert fleet._replicas[0].client.scheduler.depth != 77
+        assert fleet._replicas[0].last_step != 999
+        assert tel.metrics.snapshot()[
+            "serve_journal_stale_dropped_total"] == 2
+        assert len(tel.events("journal.stale_dropped")) == 2
+    finally:
+        fleet.shutdown()
+    assert fleet.process_backend.live_actor_count() == 0
+
+
+def test_fenced_channel_bounds_and_stamps(tmp_path):
+    """Worker-side queue ops are bounded and generation-stamped: the
+    wrapper appends the fence to every tuple, passes a timeout derived
+    from the orphan grace to every put, and swallows channel loss."""
+    from ray_lightning_tpu.launchers.serve_worker import _FencedChannel
+
+    class Rec:
+        def __init__(self, fail=False):
+            self.calls, self.fail = [], fail
+
+        def put(self, item, block=True, timeout=None):
+            if self.fail:
+                raise OSError("manager gone")
+            self.calls.append((item, block, timeout))
+
+    q = Rec()
+    ch = _FencedChannel(q, generation=7, grace_s=1.0)
+    ch.put(("batch", 0, ["x"]))
+    (item, block, timeout), = q.calls
+    assert item == ("batch", 0, ["x"], 7)
+    assert block is True and 0 < timeout <= 1.0
+    # channel loss is swallowed (the dispatch loop must outlive it);
+    # outside a spawned worker (no TL_WORKER_PROCESS) it never exits
+    dead = _FencedChannel(Rec(fail=True), generation=7, grace_s=0.0)
+    for _ in range(3):
+        dead.put(("beat",))
+    assert dead._first_fail is not None
+
+
+@pytest.mark.slow
+def test_process_two_generation_kill_chain(nano, tmp_path):
+    """Heavier chaos: kill the driver, restore, kill the RESTORED
+    driver, restore again — one journal carries both generations and
+    the final run still matches the uninterrupted reference."""
+    dec, params = nano
+    work = [dict(prompt=[5, 17, 3], max_new_tokens=24, seed=100),
+            dict(prompt=[9, 2], max_new_tokens=24, temperature=0.8,
+                 seed=101)]
+    ref_client = ServeClient(dec, params, num_slots=3, prefill_len=32)
+    for w in work:
+        ref_client.submit(**w)
+    ref = ref_client.run_until_idle()
+    ref_client.shutdown()
+
+    wal = tmp_path / "wal.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def kill_one(script_args):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", script_args[0], *script_args[1:]],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            for line in proc.stdout:
+                if line.strip() == "READY":
+                    break
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    kill_one([_DRIVER_SCRIPT, str(wal), json.dumps(work)])
+    assert read_journal(wal).generation == 0
+
+    restart = """
+import json, sys, time
+import jax, jax.numpy as jnp, numpy as np
+from ray_lightning_tpu.models import TransformerLM, gpt2_config
+from ray_lightning_tpu.serve import ReplicaFleet
+
+wal = sys.argv[1]
+mk = dict(vocab_size=128, max_seq_len=32, dtype=jnp.float32,
+          scan_layers=False)
+dec = TransformerLM(gpt2_config("nano", decode=True, **mk))
+params = TransformerLM(gpt2_config("nano", **mk)).init(
+    jax.random.PRNGKey(0), np.zeros((2, 4), np.int32))["params"]
+fleet = ReplicaFleet.restore(wal, dec, params, backend="process",
+                             num_replicas=1, orphan_grace_s=1.0,
+                             num_slots=3, prefill_len=32)
+deadline = time.time() + 240
+while time.time() < deadline:
+    fleet.tick()
+    sent = fleet._journal._sent
+    if sent and all(v >= 3 for v in sent.values()):
+        break
+    time.sleep(0.01)
+print("READY", flush=True)
+while True:
+    time.sleep(1)
+"""
+    kill_one([restart, str(wal)])
+    st = read_journal(wal)
+    assert st.generation == 1
+
+    fleet = ReplicaFleet.restore(wal, dec, params, backend="process",
+                                 num_replicas=1, orphan_grace_s=1.0,
+                                 num_slots=3, prefill_len=32)
+    try:
+        assert fleet._generation == 2
+        out = fleet.run_until_idle()
+    finally:
+        fleet.shutdown()
+    for rid, comp in ref.items():
+        if rid in out:
+            assert out[rid].tokens == comp.tokens, rid
+        else:
+            assert rid in st.retired

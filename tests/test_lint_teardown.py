@@ -50,6 +50,12 @@ RESOURCE_FACTORIES = {
     # (or executables) would pin device programs past its engine, so
     # the factory names are covered up front
     "paged_attention", "PagedAttentionKernel",
+    # driver-death survival: a Journal owns an open append-mode file
+    # handle with buffered, not-yet-fsync'd records — dropping one
+    # without shutdown()/close() loses the unsynced tail of the
+    # write-ahead log (exactly the records a warm restart needs), so
+    # any class holding `self.X = Journal(...)` must release it
+    "Journal",
     # async dispatch: a deferred-sync handle pins the enqueued
     # dispatch's device outputs (emitted/finished/carry futures) — a
     # container holding one past its engine's life would keep those
